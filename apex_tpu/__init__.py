@@ -1,0 +1,38 @@
+"""apex_tpu — a TPU-native training-accelerant framework.
+
+A from-scratch reimplementation of the capabilities of NVIDIA Apex
+(reference fork: UdonDa/apex) designed for TPU: JAX/XLA for the compute
+path, Pallas for fused kernels, and a ``jax.sharding.Mesh`` with XLA
+collectives over ICI/DCN in place of NCCL process groups.
+
+Subpackage map (reference anchors in each module's docstring):
+
+- ``apex_tpu.amp``                — mixed precision: O0–O3 opt-levels, dynamic
+  loss scaling, master weights (ref: ``apex/amp``).
+- ``apex_tpu.normalization``      — FusedLayerNorm / FusedRMSNorm Pallas kernels
+  (ref: ``apex/normalization`` + ``csrc/layer_norm_cuda*``).
+- ``apex_tpu.optimizers``         — FusedAdam / FusedLAMB / FusedSGD /
+  FusedNovoGrad (ref: ``apex/optimizers`` + ``csrc/multi_tensor_*.cu``).
+- ``apex_tpu.multi_tensor_apply`` — chunked flat-buffer multi-tensor engine
+  (ref: ``apex/multi_tensor_apply``, ``csrc/multi_tensor_apply.cuh``).
+- ``apex_tpu.parallel``           — DistributedDataParallel semantics,
+  SyncBatchNorm, LARC (ref: ``apex/parallel``).
+- ``apex_tpu.transformer``        — Megatron-style tensor/sequence/pipeline
+  parallelism over a device mesh (ref: ``apex/transformer``).
+- ``apex_tpu.contrib``            — opt-in accelerants: fused softmax
+  cross-entropy, fused multi-head attention, fast layer norm, distributed
+  (ZeRO) optimizers (ref: ``apex/contrib``).
+- ``apex_tpu.fp16_utils``         — legacy FP16_Optimizer-shaped API
+  (ref: ``apex/fp16_utils``).
+- ``apex_tpu.mlp`` / ``apex_tpu.fused_dense`` — fused MLP / dense blocks
+  (ref: ``apex/mlp``, ``apex/fused_dense``).
+"""
+
+from apex_tpu import utils  # noqa: F401
+
+__version__ = "0.1.0"
+
+# Mirror the reference's top-level convenience import (`apex/__init__.py`
+# imports `apex.parallel`). Kept lazy-ish: these are lightweight modules.
+from apex_tpu import parallel  # noqa: F401,E402
+from apex_tpu import amp  # noqa: F401,E402

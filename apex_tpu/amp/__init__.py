@@ -1,0 +1,27 @@
+"""Mixed-precision management (AMP) for TPU.
+
+Reference: ``apex/amp`` — opt-levels O0..O3 (``frontend.py``), dynamic loss
+scaling (``scaler.py``), op casting lists (``lists/``), master weights
+(``_initialize.py`` / ``_process_optimizer.py``).
+"""
+
+from apex_tpu.amp.autocast import (  # noqa: F401
+    autocast,
+    autocast_dtype,
+    cast_args,
+    is_autocast_enabled,
+)
+from apex_tpu.amp.frontend import Amp, initialize  # noqa: F401
+from apex_tpu.amp.policy import (  # noqa: F401
+    cast_inputs,
+    cast_params,
+    master_params,
+    model_params_from_master,
+)
+from apex_tpu.amp.properties import Properties, opt_levels  # noqa: F401
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScaler,
+    LossScalerState,
+    apply_if_finite,
+)
+from apex_tpu.amp import lists  # noqa: F401

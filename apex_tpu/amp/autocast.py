@@ -1,0 +1,91 @@
+"""Autocast engine — the TPU-native stand-in for apex's torch monkey-patching.
+
+Reference: ``apex/amp/amp.py :: init`` + ``apex/amp/wrap.py :: cached_cast``
+install casting shims over torch functions for O1. JAX traces pure
+functions, so global patching is both impossible and unnecessary: instead,
+apex_tpu's own ops and modules consult a (thread-local, trace-time constant)
+autocast context before running. ``cast_args`` implements the per-op policy
+from :mod:`apex_tpu.amp.lists`.
+
+Because the context is read at *trace* time, entering/exiting ``autocast``
+around a jitted call behaves like the reference's enable/disable —
+just recompile-keyed rather than patched.
+"""
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+@contextlib.contextmanager
+def autocast(dtype=jnp.bfloat16, enabled: bool = True):
+    """Enable O1-style op-policy casting within the context."""
+    _stack().append(dtype if enabled else None)
+    try:
+        yield
+    finally:
+        _stack().pop()
+
+
+def autocast_dtype() -> Optional[jnp.dtype]:
+    """The active autocast compute dtype, or None when disabled."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+def is_autocast_enabled() -> bool:
+    return autocast_dtype() is not None
+
+
+def _widest(dtypes):
+    order = {
+        jnp.dtype(jnp.float16): 0,
+        jnp.dtype(jnp.bfloat16): 1,
+        jnp.dtype(jnp.float32): 2,
+        jnp.dtype(jnp.float64): 3,
+    }
+    ranked = [jnp.dtype(d) for d in dtypes if jnp.dtype(d) in order]
+    if not ranked:
+        return None
+    return max(ranked, key=lambda d: order[d])
+
+
+def cast_args(op_name: str, *args):
+    """Apply the op policy to floating-point array args; returns a tuple.
+
+    Reference: ``apex/amp/utils.py :: casted_args``.
+    """
+    dtype = autocast_dtype()
+    if dtype is None:
+        return args
+    policy = lists.policy_for(op_name)
+    if policy == "passthrough":
+        return args
+
+    def is_float(a):
+        return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
+    if policy == "fp16":
+        target = dtype
+    elif policy == "fp32":
+        target = jnp.float32
+    else:  # promote
+        target = _widest([a.dtype for a in args if is_float(a)])
+        if target is None:
+            return args
+    return tuple(
+        a.astype(target) if is_float(a) and a.dtype != target else a
+        for a in args
+    )

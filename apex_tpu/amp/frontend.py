@@ -1,0 +1,173 @@
+"""AMP frontend: ``initialize`` and the training-step helpers.
+
+Reference: ``apex/amp/frontend.py :: def initialize`` builds a
+``Properties`` from the O0..O3 presets plus user overrides, then
+``_initialize`` rewires model+optimizer in place. Functional translation:
+
+    amp_h = amp.initialize(opt_level="O2", loss_scale="dynamic")
+    master  = amp_h.master_params(params)        # fp32 source of truth
+    state   = amp_h.init_state()                 # scaler state (pytree)
+
+    def train_step(master, opt_state, state, batch):
+        params = amp_h.cast_model(master)        # O2: bf16 except norms
+        (loss, aux), grads, found_inf, state = amp_h.value_and_grad(
+            loss_fn, has_aux=True)(params, state, amp_h.cast_input(batch))
+        updates, new_opt = optimizer.update(grads, opt_state, master)
+        new_master = optax.apply_updates(master, updates)
+        master   = amp.apply_if_finite(new_master, master, found_inf)
+        opt_state = amp.apply_if_finite(new_opt, opt_state, found_inf)
+        return master, opt_state, state, loss
+
+The ``with amp.scale_loss(loss, optimizer) as scaled_loss`` context manager
+of the reference has no backward() to wrap in JAX; its three jobs (scale,
+unscale-after-backward, update-scale) are the explicit ``scale_loss`` /
+``unscale`` / ``update_scale`` methods, or the fused ``value_and_grad``.
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import policy as _policy
+from apex_tpu.amp.autocast import autocast
+from apex_tpu.amp.properties import Properties, opt_levels
+from apex_tpu.amp.scaler import (
+    LossScaler,
+    LossScalerState,
+    apply_if_finite,  # noqa: F401  (re-exported)
+)
+
+
+class Amp:
+    """Bundle of an opt-level's Properties + a LossScaler + cast helpers."""
+
+    def __init__(self, properties: Properties):
+        self.properties = properties
+        self.scaler = LossScaler(loss_scale=properties.loss_scale)
+
+    # -- model / input casting -----------------------------------------
+    def cast_model(self, params: Any) -> Any:
+        p = self.properties
+        if p.cast_model_type is None:
+            return params
+        return _policy.cast_params(
+            params,
+            p.cast_model_type,
+            keep_batchnorm_fp32=bool(p.keep_batchnorm_fp32),
+        )
+
+    def cast_input(self, batch: Any) -> Any:
+        p = self.properties
+        if p.cast_model_type is None:
+            return batch
+        # O0 included: the reference casts floating inputs to fp32 there too.
+        return _policy.cast_inputs(batch, p.cast_model_type)
+
+    def master_params(self, params: Any) -> Any:
+        if not self.properties.master_weights:
+            return params
+        return _policy.master_params(params)
+
+    def autocast(self):
+        """O1 context: op-policy casting for apex_tpu ops in scope."""
+        p = self.properties
+        dtype = p.cast_model_type or jnp.bfloat16
+        return autocast(dtype=dtype, enabled=bool(p.patch_torch_functions))
+
+    # -- scaler ---------------------------------------------------------
+    def init_state(self) -> LossScalerState:
+        return self.scaler.init_state()
+
+    def scale_loss(self, loss, state: LossScalerState):
+        return self.scaler.scale(loss, state)
+
+    def unscale(self, grads, state: LossScalerState):
+        return self.scaler.unscale(grads, state)
+
+    def update_scale(self, state: LossScalerState, found_inf):
+        return self.scaler.update_scale(state, found_inf)
+
+    def value_and_grad(
+        self, loss_fn: Callable, has_aux: bool = False, **grad_kwargs
+    ) -> Callable:
+        """Scaled value_and_grad: computes grads of the *scaled* loss,
+        unscales them, and advances the scaler state.
+
+        Returned callable: ``(params, state, *args, **kw) ->
+        (value, grads, found_inf, new_state)`` where ``value`` is the
+        unscaled ``loss`` (or ``(loss, aux)`` with has_aux)."""
+
+        def wrapped(params, state: LossScalerState, *args, **kw):
+            def scaled_loss_fn(p, *a, **k):
+                out = loss_fn(p, *a, **k)
+                if has_aux:
+                    loss, aux = out
+                else:
+                    loss, aux = out, None
+                return self.scaler.scale(loss, state), (loss, aux)
+
+            (_, (loss, aux)), grads = jax.value_and_grad(
+                scaled_loss_fn, has_aux=True, **grad_kwargs
+            )(params, *args, **kw)
+            grads, found_inf = self.scaler.unscale(grads, state)
+            new_state = self.scaler.update_scale(state, found_inf)
+            value = (loss, aux) if has_aux else loss
+            return value, grads, found_inf, new_state
+
+        return wrapped
+
+    # -- checkpointing (ref: ``amp.state_dict``) ------------------------
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {"loss_scaler0": self.scaler.state_dict(state)}
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return self.scaler.load_state_dict(d["loss_scaler0"])
+
+
+def initialize(
+    opt_level: str = "O1",
+    *,
+    cast_model_type=None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale=None,
+    enabled: bool = True,
+    verbosity: int = 1,
+) -> Amp:
+    """Build an :class:`Amp` handle from an opt-level + overrides.
+
+    Mirrors ``apex.amp.initialize``'s knobs; model/optimizer are not
+    arguments because nothing is mutated — apply ``amp_h.cast_model`` /
+    ``amp_h.master_params`` to your param tree instead.
+    """
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r} "
+            "(options are 'O0', 'O1', 'O2', 'O3')."
+        )
+    props = opt_levels[opt_level](Properties())
+    if enabled:
+        overrides = {
+            "cast_model_type": cast_model_type,
+            "keep_batchnorm_fp32": keep_batchnorm_fp32,
+            "master_weights": master_weights,
+            "loss_scale": loss_scale,
+        }
+        props._update_options_dict(
+            {k: v for k, v in overrides.items() if v is not None}
+        )
+    else:
+        # Hard off-switch (reference parity): all other knobs are ignored.
+        props.enabled = False
+        props.patch_torch_functions = False
+        props.cast_model_type = None
+        props.master_weights = False
+        props.loss_scale = 1.0
+    if verbosity > 0:
+        import logging
+
+        logging.getLogger("apex_tpu").info(
+            "amp.initialize: opt_level=%s properties=%s", opt_level, props
+        )
+    return Amp(props)

@@ -1,0 +1,48 @@
+"""O1 op-policy tables.
+
+Reference: ``apex/amp/lists/functional_overrides.py`` / ``torch_overrides.py``
+/ ``tensor_overrides.py`` — which ops are fp16-safe (run in reduced
+precision), which are fp32-forced, and which promote to the widest input
+dtype. The reference installs these by monkey-patching torch; here they are
+consulted by apex_tpu's own ops/modules through
+:mod:`apex_tpu.amp.autocast` (there is no global framework to patch in JAX,
+and patching would break tracing).
+
+Names are canonical op identifiers used by our module library.
+"""
+
+# MXU-friendly ops: run in the autocast compute dtype (bf16/fp16).
+FP16_FUNCS = frozenset({
+    "conv1d", "conv2d", "conv3d", "conv_transpose2d",
+    "matmul", "dot", "dot_general", "einsum", "linear", "dense",
+    "bmm", "mm", "mv", "addmm", "addbmm", "baddbmm",
+    "attention_qk", "attention_av",
+})
+
+# Numerically sensitive ops: always compute in fp32.
+FP32_FUNCS = frozenset({
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "kl_div", "cosine_similarity",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "norm",
+    "exp", "expm1", "log", "log10", "log2", "log1p", "pow", "erfinv",
+    "softplus", "sigmoid_cross_entropy", "cumprod", "prod", "sum", "mean",
+    "var", "std", "renorm", "acos", "asin", "cosh", "sinh", "tan",
+})
+
+# Dtype-promoting ops: cast all args to the widest participating dtype.
+CASTS = frozenset({
+    "add", "sub", "mul", "div", "addcmul", "addcdiv",
+    "eq", "ne", "lt", "le", "gt", "ge", "equal",
+    "cat", "stack", "where", "min", "max",
+})
+
+
+def policy_for(op_name: str) -> str:
+    """Return 'fp16' | 'fp32' | 'promote' | 'passthrough' for an op name."""
+    if op_name in FP16_FUNCS:
+        return "fp16"
+    if op_name in FP32_FUNCS:
+        return "fp32"
+    if op_name in CASTS:
+        return "promote"
+    return "passthrough"

@@ -1,0 +1,86 @@
+"""Param-pytree casting and master-weight handling.
+
+Reference: ``apex/amp/_initialize.py`` (O2 model cast, keep-BN-fp32) and the
+master-param machinery in ``apex/amp/_process_optimizer.py`` /
+``apex/fp16_utils/fp16_optimizer.py``. In a functional framework the model
+is a param pytree, so "cast the model" is a tree_map and "master weights"
+is keeping the original fp32 tree as the optimizer's source of truth.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# flax param-path fragments treated as normalization params when
+# keep_batchnorm_fp32 is set. Customizable via the predicate argument.
+_NORM_PATH_MARKERS = (
+    "batchnorm", "batch_norm", "bn", "layernorm", "layer_norm", "norm",
+    "groupnorm", "group_norm", "rmsnorm", "rms_norm",
+)
+
+
+def default_norm_predicate(path: tuple) -> bool:
+    joined = "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    ).lower()
+    return any(m in joined for m in _NORM_PATH_MARKERS)
+
+
+def _is_float_leaf(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast_float_leaf(x, dtype):
+    return x.astype(dtype) if _is_float_leaf(x) else x
+
+
+def cast_params(
+    params: Any,
+    dtype,
+    keep_batchnorm_fp32: bool = False,
+    norm_predicate: Optional[Callable[[tuple], bool]] = None,
+) -> Any:
+    """Cast floating leaves of a param tree to ``dtype`` (O2/O3 model cast).
+
+    With ``keep_batchnorm_fp32``, leaves whose path looks like a
+    normalization parameter stay fp32 (ref: ``_initialize`` skipping
+    ``_BatchNorm`` modules).
+    """
+    pred = norm_predicate or default_norm_predicate
+
+    def cast(path, x):
+        if not _is_float_leaf(x):
+            return x
+        if keep_batchnorm_fp32 and pred(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def cast_inputs(batch: Any, dtype) -> Any:
+    """Cast floating inputs to the compute dtype (O2 input cast)."""
+    return jax.tree_util.tree_map(
+        lambda x: _cast_float_leaf(x, dtype), batch
+    )
+
+
+def master_params(params: Any) -> Any:
+    """fp32 master copy of a (possibly reduced-precision) param tree.
+
+    Reference: ``apex.amp.master_params(optimizer)``.
+    """
+    return cast_inputs(params, jnp.float32)
+
+
+def model_params_from_master(
+    master: Any,
+    like: Any,
+) -> Any:
+    """Re-cast master weights to the dtypes of the compute tree ``like``."""
+    return jax.tree_util.tree_map(
+        lambda m, l: m.astype(l.dtype) if hasattr(l, "dtype") else m,
+        master,
+        like,
+    )

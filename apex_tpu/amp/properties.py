@@ -1,0 +1,115 @@
+"""Opt-level property system.
+
+Reference: ``apex/amp/frontend.py :: class Properties, class O0/O1/O2/O3,
+opt_levels``. The five knobs are preserved verbatim; their meanings are
+re-grounded for TPU:
+
+- ``cast_model_type``   — dtype model params are cast to (O2/O3). On TPU the
+  default "half" is **bfloat16** (MXU-native); fp16 remains selectable for
+  experiments that need apex-faithful fp16 numerics.
+- ``patch_torch_functions`` — the reference monkey-patches torch (O1). There
+  is nothing to patch in a functional framework; the knob instead enables the
+  *op-policy autocast* consulted by apex_tpu's own module/op library
+  (see ``apex_tpu.amp.autocast``). Name kept for API parity.
+- ``keep_batchnorm_fp32`` — keep norm params/statistics fp32 when casting.
+- ``master_weights``     — maintain an fp32 master copy of params; the
+  optimizer steps the master copy and re-casts to the compute dtype.
+- ``loss_scale``         — float for static scaling or ``"dynamic"``.
+"""
+
+import dataclasses
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class Properties:
+    enabled: bool = True
+    opt_level: Optional[str] = None
+    cast_model_type: Optional[jnp.dtype] = None
+    patch_torch_functions: bool = False
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[float, str] = 1.0
+
+    def _update_options_dict(self, new_options: dict) -> None:
+        for k, v in new_options.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Tried to set unexpected option {k!r}")
+            setattr(self, k, v)
+
+    @property
+    def half_dtype(self):
+        return self.cast_model_type
+
+
+# TPU "half" default. Overridable per-initialize via cast_model_type.
+HALF = jnp.bfloat16
+
+
+class O3:
+    """FP16/BF16 everything ("speed of light" baseline)."""
+
+    brief = "O3: Pure reduced precision (bf16 on TPU)."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = HALF
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    """Half model + fp32 batchnorm + fp32 master weights + dynamic scale."""
+
+    brief = "O2: cast model to reduced precision, keep master weights in fp32."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = HALF
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1:
+    """Op-policy autocast (the reference's patch-torch-functions mode)."""
+
+    brief = "O1: per-op autocast via the amp op-policy lists."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0:
+    """Pure fp32 (the off switch that still goes through the amp API)."""
+
+    brief = "O0: pure fp32."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}

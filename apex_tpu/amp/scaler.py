@@ -1,0 +1,167 @@
+"""Static & dynamic loss scaling, jit-native.
+
+Reference: ``apex/amp/scaler.py :: class LossScaler`` — start at 2^16, halve
+on inf/nan gradients (and skip the step), double after 2000 clean steps.
+
+The reference mutates python attributes between CUDA launches; here the
+scaler *state* is a pytree (:class:`LossScalerState`) that lives inside the
+jitted train step, so scale updates and the skip decision compile into the
+step with no host sync. Overflow detection is a fused all-finite reduction
+over the grad pytree (the reference uses ``amp_C.multi_tensor_scale``'s
+overflow flag; XLA fuses our reduction into the unscale multiply).
+"""
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class LossScalerState:
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray   # i32 scalar: clean steps since last rescale
+    overflows: jnp.ndarray   # i32 scalar: total overflow count (diagnostics)
+
+
+def _leaf_finite(x: jnp.ndarray) -> jnp.ndarray:
+    """All-finite check robust to XLA excess precision.
+
+    Under jit, XLA may legally elide f32→f16→f32 convert pairs
+    (``xla_allow_excess_precision``), so an overflow that only exists in the
+    grad's storage dtype never materializes as inf for ``isfinite`` to see.
+    Compare magnitudes against the storage dtype's max instead — that
+    reduction can't be folded away.
+    """
+    wide = jnp.promote_types(x.dtype, jnp.float32)  # f64 stays f64
+    xf = x.astype(wide)
+    finite = jnp.all(jnp.isfinite(xf))
+    if (
+        jnp.issubdtype(x.dtype, jnp.floating)
+        and jnp.finfo(x.dtype).max < jnp.finfo(wide).max
+    ):
+        finite = jnp.logical_and(
+            finite, jnp.all(jnp.abs(xf) <= jnp.finfo(x.dtype).max)
+        )
+    return finite
+
+
+def _all_finite(tree: Any) -> jnp.ndarray:
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([_leaf_finite(l) for l in leaves]).all()
+
+
+class LossScaler:
+    """Pure-functional loss scaler.
+
+    ``loss_scale="dynamic"`` enables the dynamic policy; a float pins the
+    scale. All methods are (state, ...) -> (..., state) pure functions.
+    """
+
+    def __init__(
+        self,
+        loss_scale: Union[float, str] = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._init_scale = init_scale if self.dynamic else float(loss_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = (
+            min_loss_scale if min_loss_scale is not None else 1.0
+        )
+        self.max_loss_scale = max_loss_scale
+
+    # -- state ----------------------------------------------------------
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+            overflows=jnp.asarray(0, jnp.int32),
+        )
+
+    def loss_scale(self, state: LossScalerState) -> jnp.ndarray:
+        return state.loss_scale
+
+    # -- hot path -------------------------------------------------------
+    def scale(self, loss: jnp.ndarray, state: LossScalerState) -> jnp.ndarray:
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(
+        self, grads: Any, state: LossScalerState
+    ) -> Tuple[Any, jnp.ndarray]:
+        """Unscale a grad pytree; returns (unscaled_grads, found_inf).
+
+        The multiply and the finiteness reduction fuse into one pass over
+        each buffer under jit (TPU equivalent of multi_tensor_scale's
+        fused overflow flag).
+        """
+        inv = 1.0 / state.loss_scale
+        # Overflow is detected on the *incoming scaled* grads in their own
+        # storage dtype (what multi_tensor_scale's overflow_buf reports in
+        # the reference); post-unscale values shrink back under dtype max
+        # and would mask it.
+        found_inf = jnp.logical_not(_all_finite(grads))
+
+        def _unscale_leaf(g):
+            wide = jnp.promote_types(g.dtype, jnp.float32)
+            return (g.astype(wide) * inv.astype(wide)).astype(g.dtype)
+
+        unscaled = jax.tree_util.tree_map(_unscale_leaf, grads)
+        return unscaled, found_inf
+
+    def update_scale(
+        self, state: LossScalerState, found_inf: jnp.ndarray
+    ) -> LossScalerState:
+        """Dynamic policy: overflow → scale/=2, reset window; scale_window
+        clean steps → scale*=2."""
+        if not self.dynamic:
+            return state
+        overflow = found_inf
+        new_on_overflow = jnp.maximum(
+            state.loss_scale / self.scale_factor, self.min_loss_scale
+        )
+        unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        window_hit = unskipped >= self.scale_window
+        grown = jnp.minimum(
+            state.loss_scale * self.scale_factor, self.max_loss_scale
+        )
+        new_scale = jnp.where(
+            overflow, new_on_overflow, jnp.where(window_hit, grown, state.loss_scale)
+        )
+        unskipped = jnp.where(window_hit, 0, unskipped)
+        return LossScalerState(
+            loss_scale=new_scale,
+            unskipped=unskipped.astype(jnp.int32),
+            overflows=state.overflows + overflow.astype(jnp.int32),
+        )
+
+    # -- checkpointing (ref: amp state_dict carries scaler state) -------
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {
+            "loss_scale": float(state.loss_scale),
+            "unskipped": int(state.unskipped),
+            "overflows": int(state.overflows),
+        }
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            overflows=jnp.asarray(d.get("overflows", 0), jnp.int32),
+        )
+
+
+def apply_if_finite(updated_tree: Any, old_tree: Any, found_inf) -> Any:
+    """Select ``old_tree`` leaves when found_inf (the "skip step" of the
+    reference's wrapped ``optimizer.step``), compiled as a cheap select."""
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(found_inf, old, new), updated_tree, old_tree
+    )

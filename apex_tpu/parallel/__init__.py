@@ -1,0 +1,5 @@
+"""Data-parallel utilities: DDP semantics, SyncBatchNorm, LARC.
+
+Reference: ``apex/parallel/__init__.py``. Populated by the data-parallel
+build phase.
+"""

@@ -1,0 +1,17 @@
+"""Megatron-style model parallelism over a TPU device mesh.
+
+Reference: ``apex/transformer/__init__.py`` — re-exports parallel_state,
+tensor_parallel, pipeline_parallel and the AMP/functional helpers.
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)
+from apex_tpu.transformer.log_util import (  # noqa: F401
+    get_transformer_logger,
+    set_logging_level,
+)

@@ -1,0 +1,19 @@
+"""Transformer-layer utilities.
+
+Reference: ``apex/transformer/utils.py`` (``divide``, ``ensure_divisibility``,
+``split_tensor_along_last_dim``).
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.math import divide, ensure_divisibility  # noqa: F401
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Split a tensor along its last dimension into equal partitions.
+
+    Returns a tuple of arrays (contiguity is a non-concept in XLA, so the
+    reference's ``contiguous_split_chunks`` flag is dropped).
+    """
+    divide(tensor.shape[-1], num_partitions)
+    return tuple(jnp.split(tensor, num_partitions, axis=-1))

@@ -1,0 +1,24 @@
+"""Small integer-math helpers shared across the package.
+
+Reference: ``apex/transformer/utils.py :: divide, ensure_divisibility``.
+"""
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(
+            f"{numerator} is not divisible by {denominator}"
+        )
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up_to_multiple(x: int, m: int) -> int:
+    return cdiv(x, m) * m

@@ -1,0 +1,39 @@
+"""Platform detection helpers.
+
+Pallas kernels compile only on TPU backends; on CPU (the unit-test rig runs
+on an 8-virtual-device CPU mesh) they run in interpreter mode. Every Pallas
+entry point in this package accepts ``interpret=None`` meaning "pick
+automatically via :func:`pallas_interpret`".
+"""
+
+import functools
+
+import jax
+
+
+@functools.cache
+def has_tpu() -> bool:
+    """True when the default backend exposes TPU devices (incl. tunneled
+    platforms whose device_kind reports a TPU chip)."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return False
+    if not devs:
+        return False
+    d = devs[0]
+    plat = (getattr(d, "platform", "") or "").lower()
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return "tpu" in plat or "tpu" in kind
+
+
+def interpret_default() -> bool:
+    """Default value for ``pallas_call(interpret=...)``: interpret off-TPU."""
+    return not has_tpu()
+
+
+def pallas_interpret(interpret=None) -> bool:
+    """Resolve a user-supplied ``interpret`` flag (None → auto)."""
+    if interpret is None:
+        return interpret_default()
+    return bool(interpret)

@@ -1,0 +1,63 @@
+"""Regression tests for bugs found during verification/review of the amp
+subsystem."""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.transformer import parallel_state as ps
+
+
+def test_fp16_overflow_detected_under_jit():
+    """XLA excess-precision folding (f32->f16->f32 elision) must not mask
+    overflow detection (see amp/scaler.py :: _leaf_finite)."""
+    h = amp.initialize("O2", cast_model_type=jnp.float16, verbosity=0)
+    master = {"w": jnp.ones((8, 8), jnp.float32)}
+    state = h.init_state()
+
+    def loss_fn(p, x):
+        return jnp.sum(x @ p["w"]) * 1e30
+
+    @jax.jit
+    def step(master, state, x):
+        model = h.cast_model(master)
+        _, grads, found_inf, state = h.value_and_grad(loss_fn)(
+            model, state, x
+        )
+        return found_inf, state
+
+    found_inf, state = step(master, state, jnp.ones((4, 8)))
+    assert bool(found_inf)
+    assert float(state.loss_scale) == 2.0 ** 15
+
+
+def test_enabled_false_is_hard_off_switch():
+    h = amp.initialize(
+        "O2", loss_scale="dynamic", cast_model_type=jnp.bfloat16,
+        enabled=False, verbosity=0,
+    )
+    assert h.properties.cast_model_type is None
+    assert not h.scaler.dynamic
+    p = h.cast_model({"w": jnp.ones((2,), jnp.float32)})
+    assert p["w"].dtype == jnp.float32
+
+
+def test_o0_casts_inputs_to_fp32():
+    h = amp.initialize("O0", verbosity=0)
+    batch = {"x": jnp.ones((2,), jnp.bfloat16)}
+    assert h.cast_input(batch)["x"].dtype == jnp.float32
+
+
+def test_virtual_pipeline_rank_reset_on_reinitialize():
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        virtual_pipeline_model_parallel_size_=2,
+    )
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        virtual_pipeline_model_parallel_size_=2,
+    )
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 0
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=2)
+    assert ps.get_virtual_pipeline_model_parallel_rank() is None

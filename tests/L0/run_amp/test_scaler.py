@@ -1,0 +1,86 @@
+"""Loss-scaler behavior tests.
+
+Mirrors the reference's ``tests/L0/run_amp`` loss-scaler coverage: dynamic
+backoff on overflow, growth after the clean-step window, skip-step
+semantics, checkpoint round-trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+
+
+def test_static_scale_is_constant():
+    scaler = amp.LossScaler(loss_scale=128.0)
+    s = scaler.init_state()
+    assert float(s.loss_scale) == 128.0
+    loss = jnp.asarray(2.0)
+    assert float(scaler.scale(loss, s)) == 256.0
+    s2 = scaler.update_scale(s, jnp.asarray(True))
+    assert float(s2.loss_scale) == 128.0  # static never moves
+
+
+def test_dynamic_backoff_on_overflow():
+    scaler = amp.LossScaler(loss_scale="dynamic")
+    s = scaler.init_state()
+    assert float(s.loss_scale) == 2.0 ** 16
+    grads = {"w": jnp.asarray([jnp.inf, 1.0])}
+    _, found_inf = scaler.unscale(grads, s)
+    assert bool(found_inf)
+    s = scaler.update_scale(s, found_inf)
+    assert float(s.loss_scale) == 2.0 ** 15
+    assert int(s.overflows) == 1
+
+
+def test_dynamic_growth_after_window():
+    scaler = amp.LossScaler(loss_scale="dynamic", scale_window=4)
+    s = scaler.init_state()
+    clean = jnp.asarray(False)
+    for _ in range(4):
+        s = scaler.update_scale(s, clean)
+    assert float(s.loss_scale) == 2.0 ** 17
+    assert int(s.unskipped) == 0
+
+
+def test_unscale_divides_by_scale():
+    scaler = amp.LossScaler(loss_scale=4.0)
+    s = scaler.init_state()
+    grads = {"w": jnp.asarray([8.0, 4.0])}
+    out, found_inf = scaler.unscale(grads, s)
+    np.testing.assert_allclose(np.asarray(out["w"]), [2.0, 1.0])
+    assert not bool(found_inf)
+
+
+def test_apply_if_finite_skips_step():
+    new = {"w": jnp.asarray([1.0])}
+    old = {"w": jnp.asarray([0.0])}
+    kept = amp.apply_if_finite(new, old, jnp.asarray(True))
+    assert float(kept["w"][0]) == 0.0
+    applied = amp.apply_if_finite(new, old, jnp.asarray(False))
+    assert float(applied["w"][0]) == 1.0
+
+
+def test_scaler_works_under_jit():
+    scaler = amp.LossScaler(loss_scale="dynamic", scale_window=2)
+
+    @jax.jit
+    def step(state, g):
+        unscaled, found_inf = scaler.unscale(g, state)
+        return scaler.update_scale(state, found_inf), unscaled
+
+    s = scaler.init_state()
+    s, _ = step(s, {"w": jnp.asarray([1.0])})
+    s, _ = step(s, {"w": jnp.asarray([jnp.nan])})
+    assert float(s.loss_scale) == 2.0 ** 15
+
+
+def test_state_dict_roundtrip():
+    scaler = amp.LossScaler(loss_scale="dynamic")
+    s = scaler.init_state()
+    s = scaler.update_scale(s, jnp.asarray(True))
+    d = scaler.state_dict(s)
+    s2 = scaler.load_state_dict(d)
+    assert float(s2.loss_scale) == float(s.loss_scale)
+    assert int(s2.unskipped) == int(s.unskipped)

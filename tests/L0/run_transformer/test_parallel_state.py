@@ -1,0 +1,87 @@
+"""parallel_state mesh registry tests.
+
+Mirrors the intent of the reference's ``tests/L0/run_transformer``
+initialization tests, but over the 8-virtual-CPU-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def test_initialize_shapes():
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert ps.get_context_parallel_world_size() == 1
+    assert mesh.shape["model"] == 2
+    # host-side ranks are 0
+    assert ps.get_tensor_model_parallel_rank() == 0
+    assert ps.get_pipeline_model_parallel_last_rank() == 1
+
+
+def test_indivisible_world_raises():
+    with pytest.raises(ps.ParallelStateError):
+        ps.initialize_model_parallel(tensor_model_parallel_size_=3)
+
+
+def test_default_mesh_is_pure_dp():
+    mesh = ps.get_mesh()
+    assert mesh.shape["data"] == len(jax.devices())
+    assert mesh.shape["model"] == 1
+
+
+def test_tp_axis_is_innermost():
+    """Adjacent device ids must be TP neighbors (ICI locality)."""
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
+    devs = mesh.devices  # shape (dp=2, pp=1, cp=1, tp=4)
+    ids = np.array([[d.id for d in row] for row in devs[:, 0, 0, :]])
+    assert list(ids[0]) == [0, 1, 2, 3]
+    assert list(ids[1]) == [4, 5, 6, 7]
+
+
+def test_ranks_inside_shard_map():
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2
+    )
+
+    def f(x):
+        tp_r = ps.get_tensor_model_parallel_rank()
+        pp_r = ps.get_pipeline_model_parallel_rank()
+        dp_r = ps.get_data_parallel_rank()
+        return x + tp_r * 100 + pp_r * 10 + dp_r
+
+    out = shard_map(
+        f,
+        mesh=mesh,
+        in_specs=P("data", None),
+        out_specs=P("data", None),
+        check_vma=False,
+    )(jnp.zeros((2, 4)))
+    # rows belong to dp ranks 0,1; within a row all tp/pp combos... rows are
+    # sharded over data only, so each dp shard sees its own dp rank; the
+    # tp/pp contributions are whatever that device's coordinates are — just
+    # check the function traces and runs.
+    assert out.shape == (2, 4)
+
+
+def test_virtual_pipeline_bookkeeping():
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        virtual_pipeline_model_parallel_size_=2,
+    )
+    assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 1
+    assert not ps.is_pipeline_first_stage()
+    ps.set_virtual_pipeline_model_parallel_rank(0)
+    assert ps.is_pipeline_first_stage()

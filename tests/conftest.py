@@ -1,0 +1,33 @@
+"""Test rig: run everything on an 8-virtual-device CPU mesh.
+
+The reference tests multi-GPU paths only with real GPUs
+(``skipIf(torch.cuda.device_count() < N)``, SURVEY.md §4). On TPU/JAX we can
+do better: XLA's CPU backend exposes N virtual devices, so every DP/TP/PP/SP
+code path is exercised in CI with no accelerator. Pallas kernels run in
+interpreter mode off-TPU (see ``apex_tpu.utils.platform``).
+
+The session environment pins ``JAX_PLATFORMS`` to the TPU tunnel (axon) and
+``sitecustomize`` imports jax at interpreter startup, so env vars are
+already latched — we must go through ``jax.config`` instead (backends are
+not initialized until the first ``jax.devices()`` call).
+"""
+
+import os
+
+import jax
+import pytest
+
+_platform = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
+jax.config.update("jax_platforms", _platform)
+if _platform == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    """Each test starts with no global mesh installed."""
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    yield
+    parallel_state.destroy_model_parallel()
