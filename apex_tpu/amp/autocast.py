@@ -50,16 +50,17 @@ def is_autocast_enabled() -> bool:
 
 
 def _widest(dtypes):
-    order = {
-        jnp.dtype(jnp.float16): 0,
-        jnp.dtype(jnp.bfloat16): 1,
-        jnp.dtype(jnp.float32): 2,
-        jnp.dtype(jnp.float64): 3,
-    }
-    ranked = [jnp.dtype(d) for d in dtypes if jnp.dtype(d) in order]
-    if not ranked:
+    """Promotion target for mixed float inputs. Delegates to JAX's lattice:
+    f16 + bf16 promotes to f32 (neither format is a superset of the other),
+    matching ``jnp.promote_types`` rather than an ad-hoc ranking."""
+    floats = [jnp.dtype(d) for d in dtypes
+              if jnp.issubdtype(jnp.dtype(d), jnp.floating)]
+    if not floats:
         return None
-    return max(ranked, key=lambda d: order[d])
+    out = floats[0]
+    for d in floats[1:]:
+        out = jnp.promote_types(out, d)
+    return out
 
 
 def cast_args(op_name: str, *args):

@@ -92,7 +92,11 @@ class LossScaler:
 
     # -- hot path -------------------------------------------------------
     def scale(self, loss: jnp.ndarray, state: LossScalerState) -> jnp.ndarray:
-        return loss * state.loss_scale.astype(loss.dtype)
+        # The scaled loss is produced (and stays) in fp32: the default 2^16
+        # scale is not even representable in float16 (f16 max is 65504), so
+        # an f16 scaled loss would be inf regardless of gradient health.
+        # Gradients w.r.t. f16/bf16 params still flow in the param dtype.
+        return loss.astype(jnp.float32) * state.loss_scale
 
     def unscale(
         self, grads: Any, state: LossScalerState

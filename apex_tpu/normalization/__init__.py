@@ -1,0 +1,19 @@
+"""Fused normalization layers (TPU-native).
+
+Reference: ``apex/normalization/__init__.py`` exports ``FusedLayerNorm``,
+``MixedFusedLayerNorm``, ``FusedRMSNorm``, ``MixedFusedRMSNorm`` backed by
+the ``fused_layer_norm_cuda`` extension (``csrc/layer_norm_cuda_kernel.cu``).
+Here the kernels are Pallas (row-tiled, fp32 accumulation) with
+``jax.custom_vjp`` backward passes.
+"""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)

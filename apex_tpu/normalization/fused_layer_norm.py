@@ -1,0 +1,426 @@
+"""Fused LayerNorm / RMSNorm — Pallas TPU kernels with custom VJPs.
+
+TPU-native equivalent of the reference's ``fused_layer_norm_cuda`` extension
+(ref: ``csrc/layer_norm_cuda.cpp`` + ``csrc/layer_norm_cuda_kernel.cu``,
+consumed by ``apex/normalization/fused_layer_norm.py :: FusedLayerNormAffineFunction``
+/ ``FusedRMSNormAffineFunction`` / ``class FusedLayerNorm`` / ``class FusedRMSNorm``).
+
+Design (vs. the CUDA reference):
+
+- The CUDA kernels do a per-row Welford mean/var with warp reductions; on TPU
+  a row tile of shape ``(TILE_R, H)`` sits in VMEM and the VPU reduces the
+  hidden dim directly in fp32 — no Welford needed because the whole row is
+  resident.
+- The CUDA backward does a two-stage dgamma/dbeta reduction across threadblocks;
+  here partial ``(1, H)`` sums are accumulated across sequential grid steps
+  into a single fp32 output block (TPU grids execute sequentially, so the
+  revisited output block is the accumulator).
+- "Mixed" (fp16/bf16 activations with fp32 params and fp32 statistics) is the
+  only behavior: statistics and all accumulation are always fp32; outputs take
+  the input dtype, weight grads take the weight dtype.
+
+Forward saves ``(x, weight[, bias-not-needed], mean, rstd)`` — the same
+residual set the reference saves with ``ctx.save_for_backward``.
+"""
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.math import round_up_to_multiple
+from apex_tpu.utils.platform import pallas_interpret
+
+Shape = Union[int, Sequence[int]]
+
+_LANE = 128
+_SUBLANE = 8
+# VMEM working-set budget for choosing the row tile. A tile touches ~6 fp32
+# row-blocks (x, y, dy, dx, xhat temp, wdy temp) at H columns each.
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _normalized_size(normalized_shape: Shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    return int(np.prod(tuple(normalized_shape)))
+
+
+def _row_tile(n_rows: int, h: int, n_bufs: int = 6) -> int:
+    """Pick a row-tile size: multiple of the fp32 sublane count, bounded by
+    the VMEM budget and the (padded) row count."""
+    by_vmem = _VMEM_BUDGET // max(1, n_bufs * h * 4)
+    tile = max(_SUBLANE, min(512, (by_vmem // _SUBLANE) * _SUBLANE))
+    padded_rows = round_up_to_multiple(n_rows, _SUBLANE)
+    return min(tile, max(_SUBLANE, padded_rows))
+
+
+def _pad_rows(x2d: jax.Array, tile: int) -> Tuple[jax.Array, int]:
+    rows = x2d.shape[0]
+    padded = round_up_to_multiple(rows, tile)
+    if padded != rows:
+        x2d = jnp.pad(x2d, ((0, padded - rows), (0, 0)))
+    return x2d, padded
+
+
+# ---------------------------------------------------------------------------
+# Kernels. ``mode`` is "ln" or "rms"; affine params are optional positionals.
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(*refs, mode: str, eps: float, has_w: bool, has_b: bool):
+    it = iter(refs)
+    x_ref = next(it)
+    w_ref = next(it) if has_w else None
+    b_ref = next(it) if has_b else None
+    y_ref = next(it)
+    mean_ref = next(it) if mode == "ln" else None
+    rstd_ref = next(it)
+
+    x = x_ref[:].astype(jnp.float32)
+    if mode == "ln":
+        mean = jnp.mean(x, axis=1, keepdims=True)
+        xc = x - mean
+        var = jnp.mean(xc * xc, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        xhat = xc * rstd
+        mean_ref[:] = mean
+    else:
+        ms = jnp.mean(x * x, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(ms + eps)
+        xhat = x * rstd
+    rstd_ref[:] = rstd
+
+    y = xhat
+    if has_w:
+        y = y * w_ref[:].astype(jnp.float32)
+    if has_b:
+        y = y + b_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _bwd_kernel(*refs, mode: str, has_w: bool, has_b: bool):
+    it = iter(refs)
+    dy_ref = next(it)
+    x_ref = next(it)
+    w_ref = next(it) if has_w else None
+    mean_ref = next(it) if mode == "ln" else None
+    rstd_ref = next(it)
+    dx_ref = next(it)
+    dw_ref = next(it) if has_w else None
+    db_ref = next(it) if has_b else None
+
+    dy = dy_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:]
+    if mode == "ln":
+        xhat = (x - mean_ref[:]) * rstd
+    else:
+        xhat = x * rstd
+
+    wdy = dy * w_ref[:].astype(jnp.float32) if has_w else dy
+    c1 = jnp.mean(xhat * wdy, axis=1, keepdims=True)
+    if mode == "ln":
+        c2 = jnp.mean(wdy, axis=1, keepdims=True)
+        dx = (wdy - xhat * c1 - c2) * rstd
+    else:
+        dx = (wdy - xhat * c1) * rstd
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+    # dgamma/dbeta: partial (1, H) sums accumulated across sequential grid
+    # steps (the two-stage threadblock reduction of the CUDA kernel collapses
+    # to this on TPU).
+    step = pl.program_id(0)
+    if has_w:
+        @pl.when(step == 0)
+        def _():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
+        dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    if has_b:
+        @pl.when(step == 0)
+        def _():
+            db_ref[:] = jnp.zeros_like(db_ref)
+        db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _row_spec(tile: int, h: int):
+    return pl.BlockSpec((tile, h), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _stat_spec(tile: int):
+    return pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _full_spec(h: int):
+    return pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM)
+
+
+def _fwd_call(x2d, w, b, mode, eps, interpret):
+    rows, h = x2d.shape
+    tile = _row_tile(rows, h, n_bufs=4)
+    xp, padded = _pad_rows(x2d, tile)
+    grid = padded // tile
+
+    in_specs = [_row_spec(tile, h)]
+    args = [xp]
+    if w is not None:
+        in_specs.append(_full_spec(h))
+        args.append(w.reshape(1, h))
+    if b is not None:
+        in_specs.append(_full_spec(h))
+        args.append(b.reshape(1, h))
+
+    out_shape = [jax.ShapeDtypeStruct((padded, h), x2d.dtype)]
+    out_specs = [_row_spec(tile, h)]
+    if mode == "ln":
+        out_shape.append(jax.ShapeDtypeStruct((padded, 1), jnp.float32))
+        out_specs.append(_stat_spec(tile))
+    out_shape.append(jax.ShapeDtypeStruct((padded, 1), jnp.float32))
+    out_specs.append(_stat_spec(tile))
+
+    kernel = functools.partial(
+        _fwd_kernel, mode=mode, eps=eps, has_w=w is not None, has_b=b is not None
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=pallas_interpret(interpret),
+    )(*args)
+    outs = [o[:rows] for o in outs]
+    if mode == "ln":
+        y, mean, rstd = outs
+        return y, mean, rstd
+    y, rstd = outs
+    return y, None, rstd
+
+
+def _bwd_call(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
+    rows, h = x2d.shape
+    tile = _row_tile(rows, h, n_bufs=6)
+    xp, padded = _pad_rows(x2d, tile)
+    dyp, _ = _pad_rows(dy2d, tile)
+    meanp = _pad_rows(mean, tile)[0] if mode == "ln" else None
+    rstdp, _ = _pad_rows(rstd, tile)
+    grid = padded // tile
+    has_w = w is not None
+
+    in_specs = [_row_spec(tile, h), _row_spec(tile, h)]
+    args = [dyp, xp]
+    if has_w:
+        in_specs.append(_full_spec(h))
+        args.append(w.reshape(1, h))
+    if mode == "ln":
+        in_specs.append(_stat_spec(tile))
+        args.append(meanp)
+    in_specs.append(_stat_spec(tile))
+    args.append(rstdp)
+
+    out_shape = [jax.ShapeDtypeStruct((padded, h), x2d.dtype)]
+    out_specs = [_row_spec(tile, h)]
+    if has_w:
+        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_specs.append(_full_spec(h))
+    if has_b:
+        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_specs.append(_full_spec(h))
+
+    kernel = functools.partial(
+        _bwd_kernel, mode=mode, has_w=has_w, has_b=has_b
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=pallas_interpret(interpret),
+    )(*args)
+    outs = list(outs)
+    dx = outs.pop(0)[:rows]
+    dw = outs.pop(0).reshape(h) if has_w else None
+    db = outs.pop(0).reshape(h) if has_b else None
+    return dx, dw, db
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp cores. eps/interpret are non-diff leading args (hashable
+# statics), mirroring the reference's autograd.Function ctx attributes.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ln_affine(eps, interpret, x2d, w, b):
+    y, _, _ = _fwd_call(x2d, w, b, "ln", eps, interpret)
+    return y
+
+def _ln_affine_fwd(eps, interpret, x2d, w, b):
+    y, mean, rstd = _fwd_call(x2d, w, b, "ln", eps, interpret)
+    # b rides along only to carry its dtype for the cotangent (it is (H,),
+    # negligible next to the x residual).
+    return y, (x2d, w, b, mean, rstd)
+
+def _ln_affine_bwd(eps, interpret, res, dy):
+    x2d, w, b, mean, rstd = res
+    dx, dw, db = _bwd_call(dy, x2d, w, mean, rstd, "ln", True, interpret)
+    return dx, dw.astype(w.dtype), db.astype(b.dtype)
+
+_ln_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ln_plain(eps, interpret, x2d):
+    y, _, _ = _fwd_call(x2d, None, None, "ln", eps, interpret)
+    return y
+
+def _ln_plain_fwd(eps, interpret, x2d):
+    y, mean, rstd = _fwd_call(x2d, None, None, "ln", eps, interpret)
+    return y, (x2d, mean, rstd)
+
+def _ln_plain_bwd(eps, interpret, res, dy):
+    x2d, mean, rstd = res
+    dx, _, _ = _bwd_call(dy, x2d, None, mean, rstd, "ln", False, interpret)
+    return (dx,)
+
+_ln_plain.defvjp(_ln_plain_fwd, _ln_plain_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _rms_affine(eps, interpret, x2d, w):
+    y, _, _ = _fwd_call(x2d, w, None, "rms", eps, interpret)
+    return y
+
+def _rms_affine_fwd(eps, interpret, x2d, w):
+    y, _, rstd = _fwd_call(x2d, w, None, "rms", eps, interpret)
+    return y, (x2d, w, rstd)
+
+def _rms_affine_bwd(eps, interpret, res, dy):
+    x2d, w, rstd = res
+    dx, dw, _ = _bwd_call(dy, x2d, w, None, rstd, "rms", False, interpret)
+    return dx, dw.astype(w.dtype)
+
+_rms_affine.defvjp(_rms_affine_fwd, _rms_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _rms_plain(eps, interpret, x2d):
+    y, _, _ = _fwd_call(x2d, None, None, "rms", eps, interpret)
+    return y
+
+def _rms_plain_fwd(eps, interpret, x2d):
+    y, _, rstd = _fwd_call(x2d, None, None, "rms", eps, interpret)
+    return y, (x2d, rstd)
+
+def _rms_plain_bwd(eps, interpret, res, dy):
+    x2d, rstd = res
+    dx, _, _ = _bwd_call(dy, x2d, None, None, rstd, "rms", False, interpret)
+    return (dx,)
+
+_rms_plain.defvjp(_rms_plain_fwd, _rms_plain_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public functional API (names mirror apex/normalization/fused_layer_norm.py).
+# ---------------------------------------------------------------------------
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape: Shape,
+                            eps: float = 1e-5, *, interpret: Optional[bool] = None):
+    """LayerNorm over the trailing ``normalized_shape`` dims with affine
+    params (ref: ``fused_layer_norm_affine``)."""
+    h = _normalized_size(normalized_shape)
+    y = _ln_affine(float(eps), interpret, x.reshape(-1, h),
+                   weight.reshape(h), bias.reshape(h))
+    return y.reshape(x.shape)
+
+
+def fused_layer_norm(x, normalized_shape: Shape, eps: float = 1e-5,
+                     *, interpret: Optional[bool] = None):
+    h = _normalized_size(normalized_shape)
+    return _ln_plain(float(eps), interpret, x.reshape(-1, h)).reshape(x.shape)
+
+
+def fused_rms_norm_affine(x, weight, normalized_shape: Shape,
+                          eps: float = 1e-5, *, interpret: Optional[bool] = None):
+    h = _normalized_size(normalized_shape)
+    y = _rms_affine(float(eps), interpret, x.reshape(-1, h), weight.reshape(h))
+    return y.reshape(x.shape)
+
+
+def fused_rms_norm(x, normalized_shape: Shape, eps: float = 1e-5,
+                   *, interpret: Optional[bool] = None):
+    h = _normalized_size(normalized_shape)
+    return _rms_plain(float(eps), interpret, x.reshape(-1, h)).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Module-shaped API. Functional modules: ``init()`` -> params dict,
+# ``apply(params, x)`` -> output (ref: ``class FusedLayerNorm(torch.nn.Module)``).
+# ---------------------------------------------------------------------------
+
+class FusedLayerNorm:
+    """LayerNorm module (ref: ``apex/normalization/fused_layer_norm.py ::
+    class FusedLayerNorm``). Params live in a dict pytree; stats are fp32."""
+
+    mode = "ln"
+
+    def __init__(self, normalized_shape: Shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True, param_dtype=jnp.float32):
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = float(eps)
+        self.elementwise_affine = bool(elementwise_affine)
+        self.param_dtype = param_dtype
+
+    def init(self, key: Optional[jax.Array] = None) -> dict:
+        del key  # LN init is deterministic (weight=1, bias=0)
+        if not self.elementwise_affine:
+            return {}
+        params = {"weight": jnp.ones(self.normalized_shape, self.param_dtype)}
+        if self.mode == "ln":
+            params["bias"] = jnp.zeros(self.normalized_shape, self.param_dtype)
+        return params
+
+    def apply(self, params: dict, x, *, interpret: Optional[bool] = None):
+        if self.mode == "ln":
+            if self.elementwise_affine:
+                return fused_layer_norm_affine(
+                    x, params["weight"], params["bias"],
+                    self.normalized_shape, self.eps, interpret=interpret)
+            return fused_layer_norm(x, self.normalized_shape, self.eps,
+                                    interpret=interpret)
+        if self.elementwise_affine:
+            return fused_rms_norm_affine(x, params["weight"],
+                                         self.normalized_shape, self.eps,
+                                         interpret=interpret)
+        return fused_rms_norm(x, self.normalized_shape, self.eps,
+                              interpret=interpret)
+
+    __call__ = apply
+
+
+class FusedRMSNorm(FusedLayerNorm):
+    """RMSNorm module (ref: ``class FusedRMSNorm``): no mean subtraction,
+    no bias."""
+
+    mode = "rms"
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """fp16/bf16 activations with fp32 params & stats (ref:
+    ``class MixedFusedLayerNorm``). Our kernels always keep stats fp32, so
+    "mixed" only pins the param dtype."""
+
+    def __init__(self, normalized_shape: Shape, eps: float = 1e-5, **kw):
+        kw.pop("param_dtype", None)
+        super().__init__(normalized_shape, eps, param_dtype=jnp.float32, **kw)
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    def __init__(self, normalized_shape: Shape, eps: float = 1e-5, **kw):
+        kw.pop("param_dtype", None)
+        super().__init__(normalized_shape, eps, param_dtype=jnp.float32, **kw)

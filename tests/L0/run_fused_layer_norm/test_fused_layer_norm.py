@@ -1,0 +1,159 @@
+"""Golden-model tests for Pallas LayerNorm/RMSNorm.
+
+Mirrors the reference's ``tests/L0/run_fused_layer_norm/`` strategy: compare
+the fused kernels against a plain framework implementation (here pure jnp in
+fp32) under dtype-scaled tolerances, fwd and bwd, affine and plain, fp32 and
+bf16, including shapes that don't divide the row tile.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+
+
+def ref_layer_norm(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def ref_rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(ms + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(
+        rtol=2e-5, atol=2e-5)
+
+
+SHAPES = [((32, 256), 256), ((4, 17, 384), 384), ((3, 1024), 1024)]
+
+
+@pytest.mark.parametrize("shape,h", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_affine_fwd_bwd(shape, h, dtype):
+    k = jax.random.PRNGKey(0)
+    kx, kw, kb, kg = jax.random.split(k, 4)
+    x = jax.random.normal(kx, shape, dtype) * 2 + 1
+    w = jax.random.normal(kw, (h,), jnp.float32) * 0.5 + 1
+    b = jax.random.normal(kb, (h,), jnp.float32) * 0.1
+    dy = jax.random.normal(kg, shape, dtype)
+
+    got = fused_layer_norm_affine(x, w, b, h)
+    want = ref_layer_norm(x, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **tol(dtype))
+
+    def loss_f(f):
+        def inner(x, w, b):
+            return jnp.sum(f(x, w, b).astype(jnp.float32) * dy.astype(jnp.float32))
+        return inner
+
+    gx, gw, gb = jax.grad(loss_f(lambda x, w, b: fused_layer_norm_affine(x, w, b, h)),
+                          argnums=(0, 1, 2))(x, w, b)
+    rx, rw, rb = jax.grad(loss_f(lambda x, w, b: ref_layer_norm(x, w, b, 1e-5)),
+                          argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), **tol(dtype))
+    # weight grads sum over all rows — scale atol with the row count
+    n_rows = int(np.prod(shape[:-1]))
+    wtol = dict(rtol=2e-2, atol=1e-2 * max(1, n_rows) ** 0.5) \
+        if dtype == jnp.bfloat16 else dict(rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), **wtol)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), **wtol)
+
+
+@pytest.mark.parametrize("shape,h", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_affine_fwd_bwd(shape, h, dtype):
+    k = jax.random.PRNGKey(1)
+    kx, kw, kg = jax.random.split(k, 3)
+    x = jax.random.normal(kx, shape, dtype)
+    w = jax.random.normal(kw, (h,), jnp.float32) * 0.5 + 1
+    dy = jax.random.normal(kg, shape, dtype)
+
+    got = fused_rms_norm_affine(x, w, h)
+    want = ref_rms_norm(x, w, 1e-5)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **tol(dtype))
+
+    def mk(f):
+        def inner(x, w):
+            return jnp.sum(f(x, w).astype(jnp.float32) * dy.astype(jnp.float32))
+        return inner
+
+    gx, gw = jax.grad(mk(lambda x, w: fused_rms_norm_affine(x, w, h)),
+                      argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(mk(lambda x, w: ref_rms_norm(x, w, 1e-5)),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), **tol(dtype))
+    n_rows = int(np.prod(shape[:-1]))
+    wtol = dict(rtol=2e-2, atol=1e-2 * max(1, n_rows) ** 0.5) \
+        if dtype == jnp.bfloat16 else dict(rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), **wtol)
+
+
+def test_no_affine_variants():
+    x = jax.random.normal(jax.random.PRNGKey(2), (16, 128), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm(x, 128)),
+        np.asarray(ref_layer_norm(x, None, None, 1e-5)), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(fused_rms_norm(x, 128)),
+        np.asarray(ref_rms_norm(x, None, 1e-5)), rtol=2e-5, atol=2e-5)
+    # grads flow with no affine params
+    g = jax.grad(lambda x: jnp.sum(fused_layer_norm(x, 128)))(x)
+    r = jax.grad(lambda x: jnp.sum(ref_layer_norm(x, None, None, 1e-5)))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=2e-5, atol=2e-5)
+
+
+def test_module_api():
+    ln = FusedLayerNorm(256)
+    p = ln.init()
+    assert p["weight"].shape == (256,) and p["bias"].shape == (256,)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 256))
+    np.testing.assert_allclose(
+        np.asarray(ln.apply(p, x)),
+        np.asarray(ref_layer_norm(x, p["weight"], p["bias"], 1e-5)),
+        rtol=2e-5, atol=2e-5)
+
+    rms = FusedRMSNorm(256)
+    pr = rms.init()
+    assert "bias" not in pr
+    np.testing.assert_allclose(
+        np.asarray(rms.apply(pr, x)),
+        np.asarray(ref_rms_norm(x, pr["weight"], 1e-5)),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_jit_and_multidim_normalized_shape():
+    x = jax.random.normal(jax.random.PRNGKey(4), (6, 4, 64), jnp.float32)
+    w = jnp.ones((4, 64)); b = jnp.zeros((4, 64))
+    f = jax.jit(lambda x, w, b: fused_layer_norm_affine(x, w, b, (4, 64)))
+    np.testing.assert_allclose(
+        np.asarray(f(x, w, b)),
+        np.asarray(ref_layer_norm(x.reshape(6, -1), w.reshape(-1),
+                                  b.reshape(-1), 1e-5).reshape(x.shape)),
+        rtol=2e-5, atol=2e-5)
