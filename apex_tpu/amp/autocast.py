@@ -53,8 +53,12 @@ def _widest(dtypes):
     """Promotion target for mixed float inputs. Delegates to JAX's lattice:
     f16 + bf16 promotes to f32 (neither format is a superset of the other),
     matching ``jnp.promote_types`` rather than an ad-hoc ranking."""
-    floats = [jnp.dtype(d) for d in dtypes
-              if jnp.issubdtype(jnp.dtype(d), jnp.floating)]
+    # Only dtypes with an implicit promotion path participate; fp8 and other
+    # exotic floats are left out (JAX refuses implicit 8-bit-float
+    # promotion), matching the reference's fixed op lists.
+    promotable = {jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+                  jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)}
+    floats = [jnp.dtype(d) for d in dtypes if jnp.dtype(d) in promotable]
     if not floats:
         return None
     out = floats[0]

@@ -92,11 +92,13 @@ class LossScaler:
 
     # -- hot path -------------------------------------------------------
     def scale(self, loss: jnp.ndarray, state: LossScalerState) -> jnp.ndarray:
-        # The scaled loss is produced (and stays) in fp32: the default 2^16
-        # scale is not even representable in float16 (f16 max is 65504), so
-        # an f16 scaled loss would be inf regardless of gradient health.
+        # The scaled loss is produced (and stays) in >= fp32: the default
+        # 2^16 scale is not even representable in float16 (f16 max is
+        # 65504), so an f16 scaled loss would be inf regardless of gradient
+        # health. f64 losses keep their precision via the promotion lattice.
         # Gradients w.r.t. f16/bf16 params still flow in the param dtype.
-        return loss.astype(jnp.float32) * state.loss_scale
+        target = jnp.promote_types(loss.dtype, jnp.float32)
+        return loss.astype(target) * state.loss_scale.astype(target)
 
     def unscale(
         self, grads: Any, state: LossScalerState

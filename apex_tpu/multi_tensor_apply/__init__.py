@@ -1,0 +1,27 @@
+"""Multi-tensor engine (ref: ``apex/multi_tensor_apply`` + ``amp_C``).
+
+Two tiers:
+
+- List/pytree ops (``multi_tensor_scale`` …): plain jnp, fused by XLA —
+  the drop-in API surface.
+- Flat-buffer Pallas kernels (``kernels``): a single packed ``(rows, 128)``
+  buffer walked tile-by-tile — the native path for packed optimizer state
+  and DDP buckets.
+"""
+
+from apex_tpu.multi_tensor_apply.flatten import (  # noqa: F401
+    FlatSpec,
+    flatten_pytree,
+    flatten_tensors,
+    make_spec,
+    unflatten_pytree,
+    unflatten_tensors,
+)
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (  # noqa: F401
+    MultiTensorApply,
+    multi_tensor_applier,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+from apex_tpu.multi_tensor_apply import kernels  # noqa: F401

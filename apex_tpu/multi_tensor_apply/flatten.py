@@ -1,0 +1,104 @@
+"""Flat-buffer layout for the multi-tensor engine.
+
+TPU-native replacement for the reference's pointer-chunk metadata
+(ref: ``csrc/multi_tensor_apply.cuh`` builds ``TensorListMetadata`` of raw
+device pointers + per-chunk tensor indices; ``apex_C`` flatten/unflatten in
+``csrc/flatten_unflatten.cpp`` serve the DDP bucketing path).
+
+XLA has no raw pointers, so tensors are packed into ONE flat 2D buffer of
+shape ``(rows, 128)`` (128 = TPU lane count). Each tensor's span is aligned
+to a whole number of ``(8, 128)`` fp32 tiles so that:
+
+- every ``(8, 128)`` tile belongs to exactly one tensor (the per-chunk
+  ``tensor_id`` of the CUDA metadata becomes a per-tile id array, enabling
+  per-tensor reductions like LAMB trust ratios), and
+- padding never straddles a compute tile (pad lanes hold zeros).
+"""
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils.math import cdiv, round_up_to_multiple
+
+LANES = 128
+SUBLANES = 8
+TILE_ELEMS = LANES * SUBLANES  # alignment quantum per tensor
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static layout of a flat buffer: per-tensor shapes and row spans."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    row_offsets: Tuple[int, ...]   # first row of each tensor's span
+    row_counts: Tuple[int, ...]    # rows (of 128 lanes) per tensor
+    total_rows: int
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.shapes)
+
+    def tile_tensor_ids(self, tile_rows: int = SUBLANES) -> np.ndarray:
+        """int32 array mapping each row-tile to its tensor index (the
+        ``block_to_tensor`` table of the CUDA metadata)."""
+        ids = np.zeros(self.total_rows // tile_rows, np.int32)
+        for t, (off, cnt) in enumerate(zip(self.row_offsets, self.row_counts)):
+            ids[off // tile_rows: (off + cnt) // tile_rows] = t
+        return ids
+
+
+def make_spec(tensors: Sequence[jax.Array]) -> FlatSpec:
+    shapes, dtypes, offsets, counts = [], [], [], []
+    row = 0
+    for t in tensors:
+        n = int(np.prod(t.shape)) if t.ndim else 1
+        rows = round_up_to_multiple(cdiv(n, LANES), SUBLANES)
+        shapes.append(tuple(t.shape))
+        dtypes.append(t.dtype)
+        offsets.append(row)
+        counts.append(rows)
+        row += rows
+    return FlatSpec(tuple(shapes), tuple(dtypes), tuple(offsets),
+                    tuple(counts), row)
+
+
+def flatten_tensors(tensors: Sequence[jax.Array], spec: FlatSpec = None,
+                    dtype=jnp.float32) -> Tuple[jax.Array, FlatSpec]:
+    """Pack tensors into a zero-padded ``(rows, 128)`` buffer of ``dtype``."""
+    if spec is None:
+        spec = make_spec(tensors)
+    parts = []
+    for t, cnt in zip(tensors, spec.row_counts):
+        flat = t.reshape(-1).astype(dtype)
+        parts.append(jnp.pad(flat, (0, cnt * LANES - flat.shape[0])))
+    return jnp.concatenate(parts).reshape(spec.total_rows, LANES), spec
+
+
+def unflatten_tensors(buf: jax.Array, spec: FlatSpec,
+                      cast_back: bool = True) -> List[jax.Array]:
+    """Slice a flat buffer back into tensors (ref: ``apex_C.unflatten``)."""
+    out = []
+    for shape, dt, off, cnt in zip(spec.shapes, spec.dtypes,
+                                   spec.row_offsets, spec.row_counts):
+        n = int(np.prod(shape)) if shape else 1
+        t = buf[off:off + cnt].reshape(-1)[:n].reshape(shape)
+        out.append(t.astype(dt) if cast_back else t)
+    return out
+
+
+def flatten_pytree(tree: Any, dtype=jnp.float32):
+    """Pytree front-end: returns (buffer, spec, treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buf, spec = flatten_tensors(leaves, dtype=dtype)
+    return buf, spec, treedef
+
+
+def unflatten_pytree(buf: jax.Array, spec: FlatSpec, treedef,
+                     cast_back: bool = True) -> Any:
+    return jax.tree_util.tree_unflatten(
+        treedef, unflatten_tensors(buf, spec, cast_back=cast_back))

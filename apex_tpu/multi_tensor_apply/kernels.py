@@ -1,0 +1,231 @@
+"""Pallas flat-buffer kernels — the TPU-native ``amp_C``.
+
+Each kernel walks a ``(rows, 128)`` flat buffer (see ``flatten.py``) in
+``(BLOCK_ROWS, 128)`` tiles, one grid step per tile, double-buffered by the
+Pallas pipeline. Reductions emit per-tile partials that are combined outside
+the kernel (the CUDA two-stage reduction pattern of
+``csrc/multi_tensor_l2norm_kernel.cu``); the overflow flag of
+``csrc/multi_tensor_scale_kernel.cu`` becomes a per-tile finite bit reduced
+with ``jnp.all``. Optimizer updates alias their state buffers in place
+(``input_output_aliases``) so a step is a single read-modify-write pass over
+HBM, matching the one-kernel-per-step property of ``csrc/multi_tensor_adam.cu``.
+
+Hyperparameters arrive as a ``(1, N)`` fp32 array in SMEM so that traced
+values (schedules, dynamic loss scale) never trigger recompilation.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.multi_tensor_apply.flatten import LANES
+from apex_tpu.utils.math import cdiv
+from apex_tpu.utils.platform import pallas_interpret
+
+BLOCK_ROWS = 256  # (256, 128) fp32 tile = 128 KiB per buffer
+
+
+def _pad_to_block(buf: jax.Array) -> jax.Array:
+    rows = buf.shape[0]
+    padded = cdiv(rows, BLOCK_ROWS) * BLOCK_ROWS
+    if padded != rows:
+        buf = jnp.pad(buf, ((0, padded - rows), (0, 0)))
+    return buf
+
+
+def _tile_spec():
+    return pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _partial_spec():
+    return pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+# ---------------------------------------------------------------------------
+# scale (+ overflow check) — ref csrc/multi_tensor_scale_kernel.cu
+# ---------------------------------------------------------------------------
+
+def _scale_kernel(sc_ref, x_ref, out_ref, finite_ref):
+    x = x_ref[:].astype(jnp.float32)
+    out_ref[:] = (x * sc_ref[0, 0]).astype(out_ref.dtype)
+    # Overflow is judged on the INCOMING values (pre-unscale), as the
+    # reference's overflow_buf does.
+    finite_ref[0, 0] = jnp.all(jnp.isfinite(x)).astype(jnp.int32)
+
+
+def flat_scale(buf: jax.Array, scale, out_dtype=None,
+               interpret: Optional[bool] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Returns (buf * scale, found_inf: bool scalar)."""
+    rows = buf.shape[0]
+    x = _pad_to_block(buf)
+    n_tiles = x.shape[0] // BLOCK_ROWS
+    sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    out, finite = pl.pallas_call(
+        _scale_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec(), _tile_spec()],
+        out_specs=[_tile_spec(), _partial_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, out_dtype or buf.dtype),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        interpret=pallas_interpret(interpret),
+    )(sc, x)
+    return out[:rows], jnp.logical_not(jnp.all(finite == 1))
+
+
+# ---------------------------------------------------------------------------
+# axpby — ref csrc/multi_tensor_axpby_kernel.cu
+# ---------------------------------------------------------------------------
+
+def _axpby_kernel(sc_ref, x_ref, y_ref, out_ref, finite_ref):
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    r = sc_ref[0, 0] * x + sc_ref[0, 1] * y
+    out_ref[:] = r.astype(out_ref.dtype)
+    finite_ref[0, 0] = jnp.all(jnp.isfinite(r)).astype(jnp.int32)
+
+
+def flat_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None,
+               interpret: Optional[bool] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    rows = x.shape[0]
+    xp, yp = _pad_to_block(x), _pad_to_block(y)
+    n_tiles = xp.shape[0] // BLOCK_ROWS
+    sc = jnp.stack([jnp.asarray(a, jnp.float32),
+                    jnp.asarray(b, jnp.float32)]).reshape(1, 2)
+    out, finite = pl.pallas_call(
+        _axpby_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec(), _tile_spec(), _tile_spec()],
+        out_specs=[_tile_spec(), _partial_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(xp.shape, out_dtype or x.dtype),
+            jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
+        ],
+        interpret=pallas_interpret(interpret),
+    )(sc, xp, yp)
+    return out[:rows], jnp.logical_not(jnp.all(finite == 1))
+
+
+# ---------------------------------------------------------------------------
+# L2 norm — ref csrc/multi_tensor_l2norm_kernel.cu (two-stage reduction)
+# ---------------------------------------------------------------------------
+
+_SUB = 8  # fine-partial granularity = one (8, 128) fp32 tile
+_SUBS_PER_BLOCK = BLOCK_ROWS // _SUB
+
+
+def _l2_kernel(x_ref, part_ref):
+    x = x_ref[:].astype(jnp.float32)
+    # one partial per (8, 128) sub-tile — tensor spans are 8-row aligned
+    # (flatten.TILE_ELEMS), so each partial belongs to exactly one tensor.
+    part_ref[0, :] = jnp.sum((x * x).reshape(_SUBS_PER_BLOCK, _SUB * LANES),
+                             axis=1)
+
+
+def flat_l2norm_partials(buf: jax.Array,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Per-(8, 128)-sub-tile sum-of-squares partials, fp32, shape (rows/8,)
+    (padded up to a whole number of blocks; pad partials are zero).
+
+    ``sqrt(sum(partials))`` is the global norm; a segment-sum of partials by
+    ``FlatSpec.tile_tensor_ids(8)`` gives per-tensor norms (used by LAMB
+    trust ratios) — stage 2 of the CUDA two-stage reduction, done by XLA.
+    """
+    x = _pad_to_block(buf)
+    n_tiles = x.shape[0] // BLOCK_ROWS
+    parts = pl.pallas_call(
+        _l2_kernel,
+        grid=(n_tiles,),
+        in_specs=[_tile_spec()],
+        out_specs=pl.BlockSpec((1, _SUBS_PER_BLOCK), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_tiles, _SUBS_PER_BLOCK),
+                                       jnp.float32),
+        interpret=pallas_interpret(interpret),
+    )(x)
+    return parts.reshape(-1)
+
+
+def flat_l2norm(buf: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
+    return jnp.sqrt(jnp.sum(flat_l2norm_partials(buf, interpret)))
+
+
+# ---------------------------------------------------------------------------
+# Adam / AdamW — ref csrc/multi_tensor_adam.cu
+# ---------------------------------------------------------------------------
+
+def _adam_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
+                 p_out, m_out, v_out):
+    lr = sc_ref[0, 0]
+    b1 = sc_ref[0, 1]
+    b2 = sc_ref[0, 2]
+    eps = sc_ref[0, 3]
+    wd = sc_ref[0, 4]
+    c1 = sc_ref[0, 5]       # 1 - b1^t   (1.0 when bias_correction off)
+    c2 = sc_ref[0, 6]       # 1 - b2^t
+    adam_w = sc_ref[0, 7]   # 1.0 => decoupled (AdamW), 0.0 => L2 into grad
+    grad_scale = sc_ref[0, 8]  # combined inv-loss-scale (1.0 when unused)
+
+    g = g_ref[:].astype(jnp.float32) * grad_scale
+    p = p_ref[:]
+    m = m_ref[:]
+    v = v_ref[:]
+
+    g_l2 = g + (1.0 - adam_w) * wd * p
+    m = b1 * m + (1.0 - b1) * g_l2
+    v = b2 * v + (1.0 - b2) * g_l2 * g_l2
+    update = (m / c1) / (jnp.sqrt(v / c2) + eps) + adam_w * wd * p
+    p_out[:] = p - lr * update
+    m_out[:] = m
+    v_out[:] = v
+
+
+def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
+              *, lr, beta1: float, beta2: float, eps: float, step,
+              weight_decay, adam_w_mode: bool = True,
+              bias_correction: bool = True, grad_scale=1.0,
+              interpret: Optional[bool] = None
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused Adam/AdamW step over flat fp32 buffers.
+
+    ``params``/``m``/``v`` are aliased in place (donate them under jit).
+    All hyperparameters may be traced scalars.
+    """
+    rows = params.shape[0]
+    gp, pp, mp, vp = (_pad_to_block(b) for b in (grads, params, m, v))
+    n_tiles = pp.shape[0] // BLOCK_ROWS
+    t = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        c1 = 1.0 - jnp.asarray(beta1, jnp.float32) ** t
+        c2 = 1.0 - jnp.asarray(beta2, jnp.float32) ** t
+    else:
+        c1 = jnp.float32(1.0)
+        c2 = jnp.float32(1.0)
+    sc = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(beta1), jnp.float32(beta2),
+        jnp.float32(eps), jnp.asarray(weight_decay, jnp.float32), c1, c2,
+        jnp.float32(1.0 if adam_w_mode else 0.0),
+        jnp.asarray(grad_scale, jnp.float32),
+    ]).reshape(1, 9)
+    p_new, m_new, v_new = pl.pallas_call(
+        _adam_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec()] + [_tile_spec()] * 4,
+        out_specs=[_tile_spec()] * 3,
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3,
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=pallas_interpret(interpret),
+    )(sc, gp, pp, mp, vp)
+    return p_new[:rows], m_new[:rows], v_new[:rows]

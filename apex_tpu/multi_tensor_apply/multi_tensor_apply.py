@@ -1,0 +1,92 @@
+"""Apex-shaped multi-tensor API over lists/pytrees of tensors.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py ::
+class MultiTensorApply`` — a chunked launcher that feeds ``amp_C`` kernels
+lists of tensors. Under XLA the "one launch for many tensors" property falls
+out of compilation: a jitted function applying the same elementwise update
+to every leaf is fused into a handful of device kernels, so the list-level
+ops here are plain ``jnp`` tree ops. The flat-buffer Pallas engine
+(``kernels.py``) remains the native path for callers that keep state packed
+(optimizer ``flat=True`` mode, DDP bucket buffers).
+
+Ops are functional: they RETURN new tensors instead of writing the output
+list in place, and return ``found_inf`` instead of mutating an
+``overflow_buf``.
+"""
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _finite_all(tensors: Sequence[jax.Array]) -> jax.Array:
+    flags = [jnp.all(jnp.isfinite(t)) for t in tensors]
+    return jnp.logical_not(jnp.stack(flags).all()) if flags else jnp.asarray(False)
+
+
+def multi_tensor_scale(tensors: Sequence[jax.Array], scale,
+                       out_dtypes=None) -> Tuple[List[jax.Array], jax.Array]:
+    """(tensors * scale, found_inf) — ref ``amp_C.multi_tensor_scale``.
+
+    Overflow is judged on the incoming values, matching the reference's
+    overflow_buf semantics (post-scale values can shrink back into range).
+    """
+    s = jnp.asarray(scale, jnp.float32)
+    found_inf = _finite_all(tensors)
+    if out_dtypes is None:
+        out = [(t.astype(jnp.float32) * s).astype(t.dtype) for t in tensors]
+    else:
+        out = [(t.astype(jnp.float32) * s).astype(d)
+               for t, d in zip(tensors, out_dtypes)]
+    return out, found_inf
+
+
+def multi_tensor_axpby(a, xs: Sequence[jax.Array], b, ys: Sequence[jax.Array]
+                       ) -> Tuple[List[jax.Array], jax.Array]:
+    """a*x + b*y per pair — ref ``amp_C.multi_tensor_axpby``."""
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    out = [(a * x.astype(jnp.float32) + b * y.astype(jnp.float32)).astype(y.dtype)
+           for x, y in zip(xs, ys)]
+    return out, _finite_all(out)
+
+
+def multi_tensor_l2norm(tensors: Sequence[jax.Array], per_tensor: bool = False):
+    """Global L2 norm (and optionally per-tensor norms) in fp32 —
+    ref ``amp_C.multi_tensor_l2norm``."""
+    sq = [jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors]
+    if not sq:
+        z = jnp.float32(0)
+        return (z, jnp.zeros((0,), jnp.float32)) if per_tensor else z
+    total = jnp.sqrt(jnp.stack(sq).sum())
+    if per_tensor:
+        return total, jnp.sqrt(jnp.stack(sq))
+    return total
+
+
+_OPS = {
+    "scale": multi_tensor_scale,
+    "axpby": multi_tensor_axpby,
+    "l2norm": multi_tensor_l2norm,
+}
+
+
+class MultiTensorApply:
+    """API-parity shim for ``MultiTensorApply(chunk_size)(op, noop_flag,
+    tensor_lists, *args)``. ``chunk_size`` is accepted and ignored (XLA
+    picks its own tiling); ``op`` may be a callable or an op name."""
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        del noop_flag  # functional: found_inf is returned, not stored
+        if isinstance(op, str):
+            op = _OPS[op]
+        return op(*tensor_lists, *args) if tensor_lists else op(*args)
+
+
+multi_tensor_applier = MultiTensorApply()

@@ -1,0 +1,17 @@
+"""Fused optimizers (ref: ``apex/optimizers``).
+
+Functional API: ``state = opt.init(params)``;
+``params, state = opt.step(grads, params, state, found_inf=...)``.
+All state is fp32 (master-quality), updates computed in fp32 and cast back
+to the param dtype — the master-weight property of the reference's
+``master_weights``/``capturable`` variants is the default here.
+"""
+
+from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGrad,
+    NovoGradState,
+)
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState  # noqa: F401

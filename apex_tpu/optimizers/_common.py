@@ -1,0 +1,40 @@
+"""Shared helpers for the fused optimizers.
+
+The reference optimizers (``apex/optimizers``) mutate params in place and
+read ``param.grad``; here every optimizer is functional:
+
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    params, state = opt.step(grads, params, state [, found_inf=...])
+
+``found_inf`` (a traced bool from the AMP scaler) turns the step into a
+no-op, reproducing the reference's skip-on-overflow wiring without the
+optimizer/scaler back-channel (``_amp_stash``).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_zeros_f32(params: Any) -> Any:
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def select_finite(found_inf: Optional[jax.Array], new: Any, old: Any) -> Any:
+    """Keep ``old`` wherever the step must be skipped."""
+    if found_inf is None:
+        return new
+    return jax.tree.map(
+        lambda n, o: jnp.where(found_inf, o.astype(n.dtype), n), new, old)
+
+
+def f32(x) -> jax.Array:
+    return jnp.asarray(x, jnp.float32)
+
+
+def global_grad_norm(grads: Any) -> jax.Array:
+    sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+          for g in jax.tree.leaves(grads)]
+    return jnp.sqrt(jnp.stack(sq).sum()) if sq else jnp.float32(0)

@@ -1,0 +1,56 @@
+"""FusedAdagrad — ref ``apex/optimizers/fused_adagrad.py``
+(kernel: ``csrc/multi_tensor_adagrad.cu``)."""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+
+
+class AdagradState(NamedTuple):
+    step: jax.Array
+    sum: Any
+
+
+class FusedAdagrad:
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def init(self, params: Any) -> AdagradState:
+        return AdagradState(step=jnp.zeros((), jnp.int32),
+                            sum=tree_zeros_f32(params))
+
+    def step(self, grads: Any, params: Any, state: AdagradState, *,
+             lr=None, grad_scale=1.0,
+             found_inf: Optional[jax.Array] = None
+             ) -> Tuple[Any, AdagradState]:
+        lr = f32(self.lr if lr is None else lr)
+        gs = f32(grad_scale)
+        eps, wd = f32(self.eps), f32(self.weight_decay)
+
+        def upd(g, p, s):
+            g = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            if not self.adagrad_w_mode:
+                g = g + wd * p32
+            s = s + g * g
+            u = g / (jnp.sqrt(s) + eps)
+            if self.adagrad_w_mode:
+                u = u + wd * p32
+            return (p32 - lr * u).astype(p.dtype), s
+
+        out = jax.tree.map(upd, grads, params, state.sum)
+        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
+        new_sum = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
+        new_state = AdagradState(step=state.step + 1, sum=new_sum)
+
+        new_params = select_finite(found_inf, new_params, params)
+        new_state = select_finite(found_inf, new_state, state)
+        return new_params, new_state

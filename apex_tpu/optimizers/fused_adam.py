@@ -1,0 +1,126 @@
+"""FusedAdam — ref ``apex/optimizers/fused_adam.py :: class FusedAdam``
+(kernel: ``csrc/multi_tensor_adam.cu``).
+
+Two execution paths:
+
+- default: per-leaf jnp updates inside the caller's jit — XLA fuses the
+  whole step into a few elementwise kernels (the TPU analogue of the
+  single multi-tensor launch);
+- ``use_flat_kernel=True``: m/v live as packed ``(rows, 128)`` fp32 buffers
+  and the step is ONE Pallas read-modify-write pass
+  (``multi_tensor_apply.kernels.flat_adam``) — the literal native engine.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import flatten as _flatten
+from apex_tpu.multi_tensor_apply import kernels as _kernels
+from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+
+
+class AdamState(NamedTuple):
+    step: jax.Array
+    m: Any
+    v: Any
+
+
+class FusedAdam:
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 adam_w_mode: bool = True, weight_decay: float = 0.0,
+                 amsgrad: bool = False, *, use_flat_kernel: bool = False):
+        if amsgrad:
+            # matches the reference: FusedAdam raises on amsgrad
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.use_flat_kernel = use_flat_kernel
+        self._spec = None
+
+    def init(self, params: Any) -> AdamState:
+        step = jnp.zeros((), jnp.int32)
+        if self.use_flat_kernel:
+            buf, spec, _ = _flatten.flatten_pytree(params, jnp.float32)
+            self._spec = spec
+            z = jnp.zeros_like(buf)
+            return AdamState(step=step, m=z, v=jnp.zeros_like(buf))
+        return AdamState(step=step, m=tree_zeros_f32(params),
+                         v=tree_zeros_f32(params))
+
+    def step(self, grads: Any, params: Any, state: AdamState, *,
+             lr=None, grad_scale=1.0, weight_decay=None,
+             found_inf: Optional[jax.Array] = None
+             ) -> Tuple[Any, AdamState]:
+        lr = f32(self.lr if lr is None else lr)
+        wd = f32(self.weight_decay if weight_decay is None else weight_decay)
+        t = state.step + 1
+
+        if self.use_flat_kernel:
+            new_params, new_state = self._flat_step(
+                grads, params, state, lr, wd, t, grad_scale)
+        else:
+            new_params, new_state = self._tree_step(
+                grads, params, state, lr, wd, t, grad_scale)
+
+        # On overflow the reference skips optimizer.step() entirely, so
+        # params AND optimizer state (including the step count) stay put.
+        new_params = select_finite(found_inf, new_params, params)
+        new_state = select_finite(found_inf, new_state, state)
+        return new_params, new_state
+
+    # -- paths ----------------------------------------------------------
+    def _tree_step(self, grads, params, state, lr, wd, t, grad_scale):
+        b1, b2, eps = f32(self.beta1), f32(self.beta2), f32(self.eps)
+        gs = f32(grad_scale)
+        tf = t.astype(jnp.float32)
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** tf
+            c2 = 1.0 - b2 ** tf
+        else:
+            c1 = c2 = jnp.float32(1.0)
+        aw = self.adam_w_mode
+
+        def upd(g, p, m, v):
+            g = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            if not aw:
+                g = g + wd * p32
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if aw:
+                u = u + wd * p32
+            return (p32 - lr * u).astype(p.dtype), m, v
+
+        out = jax.tree.map(upd, grads, params, state.m, state.v)
+        new_params = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda o: o[1], out,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree.map(lambda o: o[2], out,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, AdamState(step=t, m=new_m, v=new_v)
+
+    def _flat_step(self, grads, params, state, lr, wd, t, grad_scale):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        if self._spec is None:
+            self._spec = _flatten.make_spec(leaves)
+        spec = self._spec
+        gbuf, _ = _flatten.flatten_tensors(
+            jax.tree_util.tree_leaves(grads), spec)
+        pbuf, _ = _flatten.flatten_tensors(leaves, spec)
+        p_new, m_new, v_new = _kernels.flat_adam(
+            gbuf, pbuf, state.m, state.v,
+            lr=lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            step=t, weight_decay=wd, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, grad_scale=grad_scale)
+        new_params = jax.tree_util.tree_unflatten(
+            treedef, _flatten.unflatten_tensors(p_new, spec))
+        return new_params, AdamState(step=t, m=m_new, v=v_new)

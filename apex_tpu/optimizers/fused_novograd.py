@@ -1,0 +1,94 @@
+"""FusedNovoGrad — ref ``apex/optimizers/fused_novograd.py``
+(kernel: ``csrc/multi_tensor_novograd.cu``).
+
+NovoGrad keeps the second moment as ONE scalar per tensor (the layer-wise
+EMA of ||g||²), so ``v`` here is a pytree of fp32 scalars. First step seeds
+``v`` with ||g||² unless ``init_zero``."""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+
+
+class NovoGradState(NamedTuple):
+    step: jax.Array
+    m: Any
+    v: Any  # per-tensor scalars
+
+
+class FusedNovoGrad:
+    def __init__(self, lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.95, 0.98), eps: float = 1e-8,
+                 weight_decay: float = 0.0, amsgrad: bool = False,
+                 reg_inside_moment: bool = False, grad_averaging: bool = True,
+                 norm_type: int = 2, init_zero: bool = False,
+                 bias_correction: bool = True):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedNovoGrad does not support the AMSGrad variant.")
+        if norm_type != 2:
+            raise ValueError("FusedNovoGrad only supports norm_type=2")
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.reg_inside_moment = reg_inside_moment
+        self.grad_averaging = grad_averaging
+        self.init_zero = init_zero
+        self.bias_correction = bias_correction
+
+    def init(self, params: Any) -> NovoGradState:
+        return NovoGradState(
+            step=jnp.zeros((), jnp.int32),
+            m=tree_zeros_f32(params),
+            v=jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params))
+
+    def step(self, grads: Any, params: Any, state: NovoGradState, *,
+             lr=None, grad_scale=1.0,
+             found_inf: Optional[jax.Array] = None
+             ) -> Tuple[Any, NovoGradState]:
+        lr = f32(self.lr if lr is None else lr)
+        gs = f32(grad_scale)
+        b1, b2, eps, wd = f32(self.beta1), f32(self.beta2), f32(self.eps), \
+            f32(self.weight_decay)
+        t = state.step + 1
+        tf = t.astype(jnp.float32)
+        first = (state.step == 0)
+        beta3 = 1.0 - b1 if self.grad_averaging else jnp.float32(1.0)
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** tf
+            c2 = 1.0 - b2 ** tf
+        else:
+            c1 = c2 = jnp.float32(1.0)
+
+        def upd(g, p, m, v):
+            g = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            gsq = jnp.sum(g * g)
+            if self.init_zero:
+                v = b2 * v + (1.0 - b2) * gsq
+            else:
+                v = jnp.where(first, gsq, b2 * v + (1.0 - b2) * gsq)
+            denom = jnp.sqrt(v / c2) + eps
+            gn = g / denom
+            if self.reg_inside_moment:
+                gn = gn + wd * p32
+            m = b1 * m + beta3 * gn
+            u = m / c1
+            if not self.reg_inside_moment:
+                u = u + wd * p32
+            return (p32 - lr * u).astype(p.dtype), m, v
+
+        out = jax.tree.map(upd, grads, params, state.m, state.v)
+        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
+        new_m = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
+        new_v = jax.tree.map(lambda o: o[2], out, is_leaf=is_tup)
+        new_state = NovoGradState(step=t, m=new_m, v=new_v)
+
+        new_params = select_finite(found_inf, new_params, params)
+        new_state = select_finite(found_inf, new_state, state)
+        return new_params, new_state

@@ -1,0 +1,74 @@
+"""FusedSGD — ref ``apex/optimizers/fused_sgd.py :: class FusedSGD``
+(kernel: ``csrc/multi_tensor_sgd_kernel.cu``).
+
+Momentum/nesterov/dampening/weight-decay semantics follow torch.optim.SGD
+as the reference does; the first momentum step seeds the buffer with the
+gradient (reference's ``first_run`` flag)."""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+
+
+class SGDState(NamedTuple):
+    step: jax.Array
+    momentum_buf: Any
+
+
+class FusedSGD:
+    def __init__(self, lr: float, momentum: float = 0.0,
+                 dampening: float = 0.0, weight_decay: float = 0.0,
+                 nesterov: bool = False, *,
+                 wd_after_momentum: bool = False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def init(self, params: Any) -> SGDState:
+        return SGDState(step=jnp.zeros((), jnp.int32),
+                        momentum_buf=tree_zeros_f32(params))
+
+    def step(self, grads: Any, params: Any, state: SGDState, *,
+             lr=None, grad_scale=1.0,
+             found_inf: Optional[jax.Array] = None
+             ) -> Tuple[Any, SGDState]:
+        lr = f32(self.lr if lr is None else lr)
+        gs = f32(grad_scale)
+        mom, damp, wd = f32(self.momentum), f32(self.dampening), \
+            f32(self.weight_decay)
+        t = state.step + 1
+        first = (state.step == 0)
+
+        def upd(g, p, buf):
+            g = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            if not self.wd_after_momentum:
+                g = g + wd * p32
+            if self.momentum > 0:
+                seeded = jnp.where(first, g, mom * buf + (1.0 - damp) * g)
+                d = g + mom * seeded if self.nesterov else seeded
+                buf = seeded
+            else:
+                d = g
+            if self.wd_after_momentum:
+                d = d + wd * p32
+            return (p32 - lr * d).astype(p.dtype), buf
+
+        out = jax.tree.map(upd, grads, params, state.momentum_buf)
+        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
+        new_buf = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
+        new_state = SGDState(step=t, momentum_buf=new_buf)
+
+        new_params = select_finite(found_inf, new_params, params)
+        new_state = select_finite(found_inf, new_state, state)
+        return new_params, new_state
