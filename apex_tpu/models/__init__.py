@@ -1,0 +1,23 @@
+"""In-tree model zoo for examples, benchmarks and tests.
+
+The reference's models are external (torchvision ResNet in
+``examples/imagenet``; Megatron-style GPT/BERT in
+``apex/transformer/testing``); these functional equivalents keep the
+framework self-contained on TPU.
+"""
+
+from apex_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    apply_bert,
+    bert_base,
+    bert_large,
+    bert_partition_specs,
+    bert_tiny,
+    init_bert,
+    mlm_loss,
+)
+from apex_tpu.models.resnet import (  # noqa: F401
+    apply_resnet,
+    cross_entropy_loss,
+    init_resnet,
+)

@@ -1,0 +1,219 @@
+"""BERT encoder (flagship / north-star model).
+
+The reference has no in-tree BERT; its test GPT/BERT live in
+``apex/transformer/testing/standalone_bert.py`` and the north-star workload
+is BERT-Large pretrain with amp O2 + FusedAdam + FusedLayerNorm. This is a
+functional BERT built on the package's own accelerants:
+
+- ``apex_tpu.normalization.fused_layer_norm_affine`` for every LayerNorm;
+- attention softmax routed through ``apex_tpu.transformer.functional``'s
+  fused kernel once built (plain jnp softmax until then);
+- params are a nested dict so the AMP O2 cast (`keep_batchnorm_fp32` treats
+  "layernorm" paths as norms) and TP sharding specs apply mechanically.
+
+Layout: activations are (batch, seq, hidden); attention is
+(batch, heads, seq, seq) — MXU-friendly, all dims static.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import layers as L
+from apex_tpu.normalization import fused_layer_norm_affine
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.1     # applied only when rng given
+    attention_dropout: float = 0.1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def bert_large() -> BertConfig:
+    return BertConfig()
+
+
+def bert_base() -> BertConfig:
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072)
+
+
+def bert_tiny() -> BertConfig:  # for tests / dryruns
+    return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                      num_heads=4, intermediate_size=256,
+                      max_position_embeddings=128)
+
+
+def init_bert(key: jax.Array, cfg: BertConfig,
+              dtype=jnp.float32) -> Dict[str, Any]:
+    keys = iter(jax.random.split(key, 6 + 8 * cfg.num_layers))
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    params: Dict[str, Any] = {
+        "embeddings": {
+            "word": L.init_embedding(next(keys), cfg.vocab_size, h, dtype),
+            "position": L.init_embedding(
+                next(keys), cfg.max_position_embeddings, h, dtype),
+            "token_type": L.init_embedding(
+                next(keys), cfg.type_vocab_size, h, dtype),
+            "layernorm": {"weight": jnp.ones((h,), jnp.float32),
+                          "bias": jnp.zeros((h,), jnp.float32)},
+        },
+        "encoder": [],
+        "mlm_head": {
+            "transform": L.init_dense(next(keys), h, h, dtype=dtype),
+            "layernorm": {"weight": jnp.ones((h,), jnp.float32),
+                          "bias": jnp.zeros((h,), jnp.float32)},
+            # decoder ties to the word embedding; only a bias is stored
+            "bias": jnp.zeros((cfg.vocab_size,), dtype),
+        },
+        "pooler": L.init_dense(next(keys), h, h, dtype=dtype),
+    }
+    for _ in range(cfg.num_layers):
+        layer = {
+            "attention": {
+                "qkv": L.init_dense(next(keys), h, 3 * h, dtype=dtype),
+                "out": L.init_dense(next(keys), h, h, dtype=dtype),
+                "layernorm": {"weight": jnp.ones((h,), jnp.float32),
+                              "bias": jnp.zeros((h,), jnp.float32)},
+            },
+            "mlp": {
+                "fc1": L.init_dense(next(keys), h, i, dtype=dtype),
+                "fc2": L.init_dense(next(keys), i, h, dtype=dtype),
+                "layernorm": {"weight": jnp.ones((h,), jnp.float32),
+                              "bias": jnp.zeros((h,), jnp.float32)},
+            },
+        }
+        params["encoder"].append(layer)
+    return params
+
+
+def _ln(p, x, eps):
+    return fused_layer_norm_affine(x, p["weight"], p["bias"],
+                                   x.shape[-1], eps).astype(x.dtype)
+
+
+def _attention(p, cfg: BertConfig, x, mask, dropout_rng=None):
+    b, s, h = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    qkv = L.dense(p["qkv"], x).reshape(b, s, 3, nh, hd)
+    q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    if mask is not None:
+        # mask: (b, s) with 1 = attend; additive -inf on padding
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    if dropout_rng is not None and cfg.attention_dropout > 0:
+        keep = jax.random.bernoulli(dropout_rng, 1 - cfg.attention_dropout,
+                                    probs.shape)
+        probs = probs * keep / (1 - cfg.attention_dropout)
+    ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+    return L.dense(p["out"], ctx)
+
+
+def _maybe_dropout(x, rate, rng):
+    if rng is None or rate <= 0:
+        return x
+    keep = jax.random.bernoulli(rng, 1 - rate, x.shape)
+    return x * keep / (1 - rate)
+
+
+def apply_bert(params: Dict[str, Any], cfg: BertConfig,
+               input_ids: jax.Array,
+               attention_mask: Optional[jax.Array] = None,
+               token_type_ids: Optional[jax.Array] = None,
+               *, dropout_rng: Optional[jax.Array] = None,
+               compute_dtype=None) -> Dict[str, jax.Array]:
+    """Returns {"hidden": (b,s,h), "mlm_logits": (b,s,vocab),
+    "pooled": (b,h)}."""
+    b, s = input_ids.shape
+    emb = params["embeddings"]
+    x = L.embedding(emb["word"], input_ids, compute_dtype)
+    x = x + L.embedding(emb["position"], jnp.arange(s), compute_dtype)[None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + L.embedding(emb["token_type"], token_type_ids, compute_dtype)
+    x = _ln(emb["layernorm"], x, cfg.layer_norm_eps)
+
+    rngs = (jax.random.split(dropout_rng, 2 * cfg.num_layers + 1)
+            if dropout_rng is not None else [None] * (2 * cfg.num_layers + 1))
+    x = _maybe_dropout(x, cfg.hidden_dropout, rngs[0])
+
+    for li, layer in enumerate(params["encoder"]):
+        att = _attention(layer["attention"], cfg, x, attention_mask,
+                         rngs[2 * li + 1])
+        att = _maybe_dropout(att, cfg.hidden_dropout, rngs[2 * li + 2])
+        x = _ln(layer["attention"]["layernorm"], x + att, cfg.layer_norm_eps)
+        mlp = L.dense(layer["mlp"]["fc2"],
+                      jax.nn.gelu(L.dense(layer["mlp"]["fc1"], x)))
+        x = _ln(layer["mlp"]["layernorm"], x + mlp, cfg.layer_norm_eps)
+
+    head = params["mlm_head"]
+    t = jax.nn.gelu(L.dense(head["transform"], x))
+    t = _ln(head["layernorm"], t, cfg.layer_norm_eps)
+    word_table = emb["word"]["embedding"].astype(t.dtype)
+    mlm_logits = (jnp.dot(t, word_table.T).astype(jnp.float32)
+                  + head["bias"].astype(jnp.float32))
+    pooled = jnp.tanh(L.dense(params["pooler"], x[:, 0]))
+    return {"hidden": x, "mlm_logits": mlm_logits, "pooled": pooled}
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array,
+             label_mask: jax.Array) -> jax.Array:
+    """Masked-LM cross entropy in fp32; labels -100 convention NOT used —
+    ``label_mask`` (1 = predict) selects positions."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    m = label_mask.astype(jnp.float32)
+    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def bert_partition_specs(params: Dict[str, Any]):
+    """Megatron-style PartitionSpecs for a BERT param tree over the global
+    mesh axes (ref layout: ``apex/transformer/tensor_parallel/layers.py`` —
+    qkv/fc1 column-sharded, out/fc2 row-sharded, embeddings vocab-sharded).
+
+    Used by pjit/GSPMD sharding of the whole-model path; the explicit
+    shard_map TP layers (phase 7) reproduce the same layout per-layer.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    tp = ps.TENSOR_AXIS
+
+    def spec_for(path) -> P:
+        keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        joined = "/".join(keys)
+        name = keys[-1]
+        if "layernorm" in joined or name == "bias" and "mlm_head" in joined:
+            return P()
+        if "word" in joined and name == "embedding":
+            return P(tp, None)          # vocab-sharded
+        if name == "embedding":
+            return P()                   # position / token-type replicated
+        if "qkv" in joined or "fc1" in joined:
+            return P(None, tp) if name == "kernel" else P(tp)
+        if ("attention/out" in joined or "fc2" in joined) and name == "kernel":
+            return P(tp, None)           # row-parallel
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(path), params)

@@ -1,0 +1,129 @@
+"""Minimal functional NN layers for the in-tree model zoo.
+
+The reference ships no layer library (its models come from torchvision /
+Megatron); these exist so the examples, benchmarks and tests are
+self-contained. Conventions: params are nested dicts of arrays; layers are
+``init_*(key, ...) -> params`` + ``apply`` functions; compute follows the
+AMP policy of the caller (params cast outside, stats in fp32).
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lecun_normal(key, shape, fan_in, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+
+
+def kaiming_normal(key, shape, fan_in, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+
+def trunc_normal(key, shape, stddev=0.02, dtype=jnp.float32):
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * stddev
+
+
+# -- dense ------------------------------------------------------------------
+
+def init_dense(key, in_features: int, out_features: int, *, bias: bool = True,
+               init=trunc_normal, dtype=jnp.float32) -> dict:
+    p = {"kernel": init(key, (in_features, out_features), dtype=dtype)
+         if init is trunc_normal
+         else init(key, (in_features, out_features), in_features, dtype)}
+    if bias:
+        p["bias"] = jnp.zeros((out_features,), dtype)
+    return p
+
+
+def dense(params: dict, x: jax.Array) -> jax.Array:
+    # No explicit preferred_element_type: widening the output would make the
+    # transpose (backward) call dot/conv with an f32 cotangent against a
+    # bf16 kernel (dtype-mismatch); the MXU accumulates bf16 matmuls in f32
+    # internally regardless.
+    y = jnp.dot(x, params["kernel"].astype(x.dtype))
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
+# -- conv (NHWC) ------------------------------------------------------------
+
+def init_conv(key, in_ch: int, out_ch: int, kernel: Tuple[int, int],
+              dtype=jnp.float32) -> dict:
+    fan_in = in_ch * kernel[0] * kernel[1]
+    return {"kernel": kaiming_normal(
+        key, kernel + (in_ch, out_ch), fan_in, dtype)}
+
+
+def conv(params: dict, x: jax.Array, stride: int = 1,
+         padding="SAME") -> jax.Array:
+    return lax.conv_general_dilated(
+        x, params["kernel"].astype(x.dtype),
+        window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+# -- batch norm -------------------------------------------------------------
+
+def init_batchnorm(ch: int) -> Tuple[dict, dict]:
+    """Returns (params, running_state). Params fp32 (AMP keep_batchnorm_fp32
+    default), running stats fp32."""
+    params = {"scale": jnp.ones((ch,), jnp.float32),
+              "bias": jnp.zeros((ch,), jnp.float32)}
+    state = {"mean": jnp.zeros((ch,), jnp.float32),
+             "var": jnp.ones((ch,), jnp.float32)}
+    return params, state
+
+
+def batchnorm(params: dict, state: dict, x: jax.Array, *, train: bool,
+              momentum: float = 0.9, eps: float = 1e-5,
+              axis_name: Optional[str] = None
+              ) -> Tuple[jax.Array, dict]:
+    """BatchNorm over all but the channel (last) axis.
+
+    ``axis_name``: when set and running inside shard_map/pmap, batch
+    statistics are averaged across that mesh axis — this is the SyncBN hook
+    used by ``apex_tpu.parallel.SyncBatchNorm`` (ref:
+    ``apex/parallel/sync_batchnorm.py``).
+    """
+    x32 = x.astype(jnp.float32)
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x32, axis=axes)
+        mean_sq = jnp.mean(jnp.square(x32), axis=axes)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+        var = mean_sq - jnp.square(mean)
+        n = x32.size // x32.shape[-1]
+        if axis_name is not None:
+            n = n * lax.psum(1, axis_name)
+        unbiased = var * (n / max(n - 1, 1))
+        new_state = {
+            "mean": momentum * state["mean"] + (1 - momentum) * mean,
+            "var": momentum * state["var"] + (1 - momentum) * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    y = y * params["scale"] + params["bias"]
+    return y.astype(x.dtype), new_state
+
+
+# -- embedding --------------------------------------------------------------
+
+def init_embedding(key, vocab: int, features: int,
+                   dtype=jnp.float32) -> dict:
+    return {"embedding": trunc_normal(key, (vocab, features), dtype=dtype)}
+
+
+def embedding(params: dict, ids: jax.Array, dtype=None) -> jax.Array:
+    table = params["embedding"]
+    if dtype is not None:
+        table = table.astype(dtype)
+    return jnp.take(table, ids, axis=0)

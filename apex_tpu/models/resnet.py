@@ -1,0 +1,136 @@
+"""ResNet (v1.5, NHWC) — the ``examples/imagenet`` acceptance model.
+
+Reference entry point: ``examples/imagenet/main_amp.py`` builds a
+torchvision ResNet-50; this in-tree functional equivalent exists because
+torchvision isn't part of the TPU stack. BatchNorm threads running stats
+explicitly and takes an ``axis_name`` so the same model runs under
+SyncBatchNorm (``apex_tpu.parallel``) without modification.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import layers as L
+
+# (block counts, bottleneck?) per variant
+_SPECS = {
+    18: ((2, 2, 2, 2), False),
+    34: ((3, 4, 6, 3), False),
+    50: ((3, 4, 6, 3), True),
+    101: ((3, 4, 23, 3), True),
+    152: ((3, 8, 36, 3), True),
+}
+
+
+def init_resnet(key: jax.Array, depth: int = 50, num_classes: int = 1000,
+                dtype=jnp.float32) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    blocks, bottleneck = _SPECS[depth]
+    keys = iter(jax.random.split(key, 4 + sum(blocks) * 4 + 8))
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+
+    params["stem_conv"] = L.init_conv(next(keys), 3, 64, (7, 7), dtype)
+    params["stem_bn"], stats["stem_bn"] = L.init_batchnorm(64)
+
+    in_ch = 64
+    for si, n in enumerate(blocks):
+        width = 64 * (2 ** si)
+        out_ch = width * (4 if bottleneck else 1)
+        for bi in range(n):
+            name = f"layer{si + 1}_{bi}"
+            bp: Dict[str, Any] = {}
+            bs: Dict[str, Any] = {}
+            stride = 2 if (si > 0 and bi == 0) else 1
+            if bottleneck:
+                bp["conv1"] = L.init_conv(next(keys), in_ch, width, (1, 1), dtype)
+                bp["bn1"], bs["bn1"] = L.init_batchnorm(width)
+                bp["conv2"] = L.init_conv(next(keys), width, width, (3, 3), dtype)
+                bp["bn2"], bs["bn2"] = L.init_batchnorm(width)
+                bp["conv3"] = L.init_conv(next(keys), width, out_ch, (1, 1), dtype)
+                bp["bn3"], bs["bn3"] = L.init_batchnorm(out_ch)
+            else:
+                bp["conv1"] = L.init_conv(next(keys), in_ch, width, (3, 3), dtype)
+                bp["bn1"], bs["bn1"] = L.init_batchnorm(width)
+                bp["conv2"] = L.init_conv(next(keys), width, out_ch, (3, 3), dtype)
+                bp["bn2"], bs["bn2"] = L.init_batchnorm(out_ch)
+            if stride != 1 or in_ch != out_ch:
+                bp["proj_conv"] = L.init_conv(next(keys), in_ch, out_ch,
+                                              (1, 1), dtype)
+                bp["proj_bn"], bs["proj_bn"] = L.init_batchnorm(out_ch)
+            params[name] = bp
+            stats[name] = bs
+            in_ch = out_ch
+
+    params["fc"] = L.init_dense(next(keys), in_ch, num_classes,
+                                init=L.lecun_normal, dtype=dtype)
+    return params, stats
+
+
+def _block(bp, bs, x, *, stride, bottleneck, train, axis_name, momentum):
+    ns = {}
+    y = x
+    if bottleneck:
+        y = L.conv(bp["conv1"], y, 1)
+        y, ns["bn1"] = L.batchnorm(bp["bn1"], bs["bn1"], y, train=train,
+                                   axis_name=axis_name, momentum=momentum)
+        y = jax.nn.relu(y)
+        y = L.conv(bp["conv2"], y, stride)
+        y, ns["bn2"] = L.batchnorm(bp["bn2"], bs["bn2"], y, train=train,
+                                   axis_name=axis_name, momentum=momentum)
+        y = jax.nn.relu(y)
+        y = L.conv(bp["conv3"], y, 1)
+        y, ns["bn3"] = L.batchnorm(bp["bn3"], bs["bn3"], y, train=train,
+                                   axis_name=axis_name, momentum=momentum)
+    else:
+        y = L.conv(bp["conv1"], y, stride)
+        y, ns["bn1"] = L.batchnorm(bp["bn1"], bs["bn1"], y, train=train,
+                                   axis_name=axis_name, momentum=momentum)
+        y = jax.nn.relu(y)
+        y = L.conv(bp["conv2"], y, 1)
+        y, ns["bn2"] = L.batchnorm(bp["bn2"], bs["bn2"], y, train=train,
+                                   axis_name=axis_name, momentum=momentum)
+    if "proj_conv" in bp:
+        sc = L.conv(bp["proj_conv"], x, stride)
+        sc, ns["proj_bn"] = L.batchnorm(bp["proj_bn"], bs["proj_bn"], sc,
+                                        train=train, axis_name=axis_name,
+                                        momentum=momentum)
+    else:
+        sc = x
+    return jax.nn.relu(y + sc), ns
+
+
+def apply_resnet(params: Dict, stats: Dict, x: jax.Array, depth: int = 50,
+                 *, train: bool = True, axis_name: Optional[str] = None,
+                 momentum: float = 0.9
+                 ) -> Tuple[jax.Array, Dict]:
+    """x: (N, H, W, 3). Returns (logits, new_batch_stats)."""
+    blocks, bottleneck = _SPECS[depth]
+    new_stats: Dict[str, Any] = {}
+    y = L.conv(params["stem_conv"], x, 2)
+    y, new_stats["stem_bn"] = L.batchnorm(
+        params["stem_bn"], stats["stem_bn"], y, train=train,
+        axis_name=axis_name, momentum=momentum)
+    y = jax.nn.relu(y)
+    y = jax.lax.reduce_window(
+        y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+    for si, n in enumerate(blocks):
+        for bi in range(n):
+            name = f"layer{si + 1}_{bi}"
+            stride = 2 if (si > 0 and bi == 0) else 1
+            y, new_stats[name] = _block(
+                params[name], stats[name], y, stride=stride,
+                bottleneck=bottleneck, train=train, axis_name=axis_name,
+                momentum=momentum)
+
+    y = jnp.mean(y, axis=(1, 2))
+    return L.dense(params["fc"], y), new_stats
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

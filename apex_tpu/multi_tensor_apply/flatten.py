@@ -27,6 +27,10 @@ from apex_tpu.utils.math import cdiv, round_up_to_multiple
 LANES = 128
 SUBLANES = 8
 TILE_ELEMS = LANES * SUBLANES  # alignment quantum per tensor
+# Whole-buffer alignment: one kernel grid block (kernels.BLOCK_ROWS) so the
+# flat kernels never pad/slice (keeps input_output_aliases a true in-place
+# update).
+ALIGN_ROWS = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,8 +49,11 @@ class FlatSpec:
 
     def tile_tensor_ids(self, tile_rows: int = SUBLANES) -> np.ndarray:
         """int32 array mapping each row-tile to its tensor index (the
-        ``block_to_tensor`` table of the CUDA metadata)."""
-        ids = np.zeros(self.total_rows // tile_rows, np.int32)
+        ``block_to_tensor`` table of the CUDA metadata). The ALIGN_ROWS
+        tail padding is attributed to the last tensor — harmless, since the
+        pad lanes are zero and contribute nothing to any reduction."""
+        ids = np.full(self.total_rows // tile_rows,
+                      max(self.num_tensors - 1, 0), np.int32)
         for t, (off, cnt) in enumerate(zip(self.row_offsets, self.row_counts)):
             ids[off // tile_rows: (off + cnt) // tile_rows] = t
         return ids
@@ -64,7 +71,7 @@ def make_spec(tensors: Sequence[jax.Array]) -> FlatSpec:
         counts.append(rows)
         row += rows
     return FlatSpec(tuple(shapes), tuple(dtypes), tuple(offsets),
-                    tuple(counts), row)
+                    tuple(counts), round_up_to_multiple(row, ALIGN_ROWS))
 
 
 def flatten_tensors(tensors: Sequence[jax.Array], spec: FlatSpec = None,
@@ -73,9 +80,14 @@ def flatten_tensors(tensors: Sequence[jax.Array], spec: FlatSpec = None,
     if spec is None:
         spec = make_spec(tensors)
     parts = []
+    used = 0
     for t, cnt in zip(tensors, spec.row_counts):
         flat = t.reshape(-1).astype(dtype)
         parts.append(jnp.pad(flat, (0, cnt * LANES - flat.shape[0])))
+        used += cnt
+    tail = spec.total_rows - used  # ALIGN_ROWS tail padding
+    if tail:
+        parts.append(jnp.zeros((tail * LANES,), dtype))
     return jnp.concatenate(parts).reshape(spec.total_rows, LANES), spec
 
 

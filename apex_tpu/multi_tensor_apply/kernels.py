@@ -26,7 +26,11 @@ from apex_tpu.multi_tensor_apply.flatten import LANES
 from apex_tpu.utils.math import cdiv
 from apex_tpu.utils.platform import pallas_interpret
 
-BLOCK_ROWS = 256  # (256, 128) fp32 tile = 128 KiB per buffer
+from apex_tpu.multi_tensor_apply.flatten import ALIGN_ROWS
+
+BLOCK_ROWS = ALIGN_ROWS  # (256, 128) fp32 tile = 128 KiB per buffer;
+# equals the FlatSpec whole-buffer alignment so flat buffers never need
+# pad/slice here (input_output_aliases stays a true in-place update)
 
 
 def _pad_to_block(buf: jax.Array) -> jax.Array:

@@ -19,10 +19,13 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.scaler import _all_finite
 
-def _finite_all(tensors: Sequence[jax.Array]) -> jax.Array:
-    flags = [jnp.all(jnp.isfinite(t)) for t in tensors]
-    return jnp.logical_not(jnp.stack(flags).all()) if flags else jnp.asarray(False)
+
+def _found_inf(tensors: Sequence[jax.Array]) -> jax.Array:
+    # Uses the scaler's excess-precision-robust check: under jit XLA may
+    # elide f32->f16->f32 convert pairs, hiding infs from a bare isfinite.
+    return jnp.logical_not(_all_finite(list(tensors)))
 
 
 def multi_tensor_scale(tensors: Sequence[jax.Array], scale,
@@ -33,7 +36,7 @@ def multi_tensor_scale(tensors: Sequence[jax.Array], scale,
     overflow_buf semantics (post-scale values can shrink back into range).
     """
     s = jnp.asarray(scale, jnp.float32)
-    found_inf = _finite_all(tensors)
+    found_inf = _found_inf(tensors)
     if out_dtypes is None:
         out = [(t.astype(jnp.float32) * s).astype(t.dtype) for t in tensors]
     else:
@@ -49,7 +52,7 @@ def multi_tensor_axpby(a, xs: Sequence[jax.Array], b, ys: Sequence[jax.Array]
     b = jnp.asarray(b, jnp.float32)
     out = [(a * x.astype(jnp.float32) + b * y.astype(jnp.float32)).astype(y.dtype)
            for x, y in zip(xs, ys)]
-    return out, _finite_all(out)
+    return out, _found_inf(out)
 
 
 def multi_tensor_l2norm(tensors: Sequence[jax.Array], per_tensor: bool = False):
@@ -65,17 +68,14 @@ def multi_tensor_l2norm(tensors: Sequence[jax.Array], per_tensor: bool = False):
     return total
 
 
-_OPS = {
-    "scale": multi_tensor_scale,
-    "axpby": multi_tensor_axpby,
-    "l2norm": multi_tensor_l2norm,
-}
-
-
 class MultiTensorApply:
-    """API-parity shim for ``MultiTensorApply(chunk_size)(op, noop_flag,
-    tensor_lists, *args)``. ``chunk_size`` is accepted and ignored (XLA
-    picks its own tiling); ``op`` may be a callable or an op name."""
+    """API-parity shim for the apex calling convention
+    ``MultiTensorApply(chunk_size)(op, noop_flag, tensor_lists, *args)``
+    where ``tensor_lists`` is a LIST OF LISTS (e.g. ``[src, dst]`` for
+    scale, ``[xs, ys, outs]`` for axpby). ``chunk_size`` and ``noop_flag``
+    are accepted and ignored (XLA tiles; found_inf is returned, not
+    stored); output lists select the out dtypes and are otherwise unused
+    (functional: results are returned)."""
 
     available = True
 
@@ -83,10 +83,20 @@ class MultiTensorApply:
         self.chunk_size = chunk_size
 
     def __call__(self, op, noop_flag, tensor_lists, *args):
-        del noop_flag  # functional: found_inf is returned, not stored
-        if isinstance(op, str):
-            op = _OPS[op]
-        return op(*tensor_lists, *args) if tensor_lists else op(*args)
+        del noop_flag
+        if callable(op):
+            return op(*tensor_lists, *args)
+        if op == "scale":
+            (src, *rest) = tensor_lists
+            out_dtypes = [t.dtype for t in rest[0]] if rest else None
+            return multi_tensor_scale(src, args[0], out_dtypes)
+        if op == "axpby":
+            xs, ys = tensor_lists[0], tensor_lists[1]
+            a, b = args[0], args[1]
+            return multi_tensor_axpby(a, xs, b, ys)
+        if op == "l2norm":
+            return multi_tensor_l2norm(tensor_lists[0], *args)
+        raise ValueError(f"unknown multi-tensor op: {op!r}")
 
 
 multi_tensor_applier = MultiTensorApply()

@@ -12,10 +12,13 @@ no-op, reproducing the reference's skip-on-overflow wiring without the
 optimizer/scaler back-channel (``_amp_stash``).
 """
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import apply_if_finite
+from apex_tpu.multi_tensor_apply import multi_tensor_l2norm
 
 
 def tree_zeros_f32(params: Any) -> Any:
@@ -23,11 +26,10 @@ def tree_zeros_f32(params: Any) -> Any:
 
 
 def select_finite(found_inf: Optional[jax.Array], new: Any, old: Any) -> Any:
-    """Keep ``old`` wherever the step must be skipped."""
+    """Keep ``old`` wherever the step must be skipped (None = never skip)."""
     if found_inf is None:
         return new
-    return jax.tree.map(
-        lambda n, o: jnp.where(found_inf, o.astype(n.dtype), n), new, old)
+    return apply_if_finite(new, old, found_inf)
 
 
 def f32(x) -> jax.Array:
@@ -35,6 +37,13 @@ def f32(x) -> jax.Array:
 
 
 def global_grad_norm(grads: Any) -> jax.Array:
-    sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
-          for g in jax.tree.leaves(grads)]
-    return jnp.sqrt(jnp.stack(sq).sum()) if sq else jnp.float32(0)
+    return multi_tensor_l2norm(jax.tree.leaves(grads))
+
+
+def tree_unzip(out: Any, n: int) -> Tuple[Any, ...]:
+    """Split a tree whose leaves are n-tuples into n trees (the common
+    post-``tree.map`` unpacking in every optimizer's step)."""
+    is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+    return tuple(
+        jax.tree.map(lambda o, i=i: o[i], out, is_leaf=is_tup)
+        for i in range(n))

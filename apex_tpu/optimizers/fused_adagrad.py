@@ -6,7 +6,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+from apex_tpu.optimizers._common import (
+    f32, select_finite, tree_unzip, tree_zeros_f32,
+)
 
 
 class AdagradState(NamedTuple):
@@ -46,9 +48,7 @@ class FusedAdagrad:
             return (p32 - lr * u).astype(p.dtype), s
 
         out = jax.tree.map(upd, grads, params, state.sum)
-        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
-        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
-        new_sum = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
+        new_params, new_sum = tree_unzip(out, 2)
         new_state = AdagradState(step=state.step + 1, sum=new_sum)
 
         new_params = select_finite(found_inf, new_params, params)

@@ -7,8 +7,11 @@ Two execution paths:
   whole step into a few elementwise kernels (the TPU analogue of the
   single multi-tensor launch);
 - ``use_flat_kernel=True``: m/v live as packed ``(rows, 128)`` fp32 buffers
-  and the step is ONE Pallas read-modify-write pass
-  (``multi_tensor_apply.kernels.flat_adam``) — the literal native engine.
+  updated in place by ONE Pallas pass (``kernels.flat_adam``; buffers are
+  BLOCK_ROWS-aligned so aliasing is copy-free). Grads and params still
+  round-trip through flatten/unflatten each step (~3 extra HBM passes), so
+  this path pays off only when per-leaf launch overhead dominates (very
+  many small tensors); the tree path is the default for good reason.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -18,7 +21,9 @@ import jax.numpy as jnp
 
 from apex_tpu.multi_tensor_apply import flatten as _flatten
 from apex_tpu.multi_tensor_apply import kernels as _kernels
-from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+from apex_tpu.optimizers._common import (
+    f32, select_finite, tree_unzip, tree_zeros_f32,
+)
 
 
 class AdamState(NamedTuple):
@@ -42,15 +47,17 @@ class FusedAdam:
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
         self.use_flat_kernel = use_flat_kernel
-        self._spec = None
+        # layout cache keyed by treedef: one optimizer instance may serve
+        # several param trees (init called more than once)
+        self._specs = {}
 
     def init(self, params: Any) -> AdamState:
         step = jnp.zeros((), jnp.int32)
         if self.use_flat_kernel:
-            buf, spec, _ = _flatten.flatten_pytree(params, jnp.float32)
-            self._spec = spec
-            z = jnp.zeros_like(buf)
-            return AdamState(step=step, m=z, v=jnp.zeros_like(buf))
+            buf, spec, treedef = _flatten.flatten_pytree(params, jnp.float32)
+            self._specs[treedef] = spec
+            return AdamState(step=step, m=jnp.zeros_like(buf),
+                             v=jnp.zeros_like(buf))
         return AdamState(step=step, m=tree_zeros_f32(params),
                          v=tree_zeros_f32(params))
 
@@ -100,19 +107,14 @@ class FusedAdam:
             return (p32 - lr * u).astype(p.dtype), m, v
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
-        new_params = jax.tree.map(lambda o: o[0], out,
-                                  is_leaf=lambda x: isinstance(x, tuple))
-        new_m = jax.tree.map(lambda o: o[1], out,
-                             is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree.map(lambda o: o[2], out,
-                             is_leaf=lambda x: isinstance(x, tuple))
+        new_params, new_m, new_v = tree_unzip(out, 3)
         return new_params, AdamState(step=t, m=new_m, v=new_v)
 
     def _flat_step(self, grads, params, state, lr, wd, t, grad_scale):
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        if self._spec is None:
-            self._spec = _flatten.make_spec(leaves)
-        spec = self._spec
+        spec = self._specs.get(treedef)
+        if spec is None:
+            spec = self._specs[treedef] = _flatten.make_spec(leaves)
         gbuf, _ = _flatten.flatten_tensors(
             jax.tree_util.tree_leaves(grads), spec)
         pbuf, _ = _flatten.flatten_tensors(leaves, spec)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers._common import (
-    f32, global_grad_norm, select_finite, tree_zeros_f32,
+    f32, global_grad_norm, select_finite, tree_unzip, tree_zeros_f32,
 )
 
 
@@ -101,9 +101,7 @@ class FusedLAMB:
             return (p32 - lr * ratio * u).astype(p.dtype), m, v
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
-        tup = lambda i: jax.tree.map(  # noqa: E731
-            lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        new_params, new_m, new_v = tup(0), tup(1), tup(2)
+        new_params, new_m, new_v = tree_unzip(out, 3)
         new_state = LambState(step=t, m=new_m, v=new_v)
 
         new_params = select_finite(found_inf, new_params, params)

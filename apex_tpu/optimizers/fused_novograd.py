@@ -10,7 +10,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+from apex_tpu.optimizers._common import (
+    f32, select_finite, tree_unzip, tree_zeros_f32,
+)
 
 
 class NovoGradState(NamedTuple):
@@ -83,10 +85,7 @@ class FusedNovoGrad:
             return (p32 - lr * u).astype(p.dtype), m, v
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
-        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
-        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
-        new_m = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
-        new_v = jax.tree.map(lambda o: o[2], out, is_leaf=is_tup)
+        new_params, new_m, new_v = tree_unzip(out, 3)
         new_state = NovoGradState(step=t, m=new_m, v=new_v)
 
         new_params = select_finite(found_inf, new_params, params)

@@ -10,7 +10,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers._common import f32, select_finite, tree_zeros_f32
+from apex_tpu.optimizers._common import (
+    f32, select_finite, tree_unzip, tree_zeros_f32,
+)
 
 
 class SGDState(NamedTuple):
@@ -64,9 +66,7 @@ class FusedSGD:
             return (p32 - lr * d).astype(p.dtype), buf
 
         out = jax.tree.map(upd, grads, params, state.momentum_buf)
-        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
-        new_params = jax.tree.map(lambda o: o[0], out, is_leaf=is_tup)
-        new_buf = jax.tree.map(lambda o: o[1], out, is_leaf=is_tup)
+        new_params, new_buf = tree_unzip(out, 2)
         new_state = SGDState(step=t, momentum_buf=new_buf)
 
         new_params = select_finite(found_inf, new_params, params)

@@ -1,0 +1,51 @@
+"""Training metrics (ref: the ``AverageMeter`` the examples roll by hand in
+``examples/imagenet/main_amp.py``, promoted to a shared utility)."""
+
+import time
+from typing import Optional
+
+
+class AverageMeter:
+    def __init__(self, name: str = "", fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val: float, n: int = 1):
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return ("{name} {val" + self.fmt + "} ({avg" + self.fmt + "})").format(
+            name=self.name, val=self.val, avg=self.avg)
+
+
+class Throughput:
+    """samples/sec with device-sync-aware timing: call ``start()`` after the
+    warmup step (first call compiles), ``tick(n)`` per step."""
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self.samples = 0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        self.samples = 0
+
+    def tick(self, n: int):
+        self.samples += n
+
+    @property
+    def per_sec(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self._t0
+        return self.samples / dt if dt > 0 else 0.0

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: BERT-Large pretrain step (amp O2 + FusedAdam +
+FusedLayerNorm), samples/sec/chip — the north-star metric of BASELINE.json.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured/previous-round (BENCH_r*.json) when available,
+else null (the reference publishes no numbers — BASELINE.md).
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from apex_tpu import amp
+    from apex_tpu.models import apply_bert, bert_large, bert_tiny, init_bert, mlm_loss
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.utils.platform import has_tpu
+
+    on_tpu = has_tpu()
+    cfg = bert_large() if on_tpu else bert_tiny()
+    batch, seq = (16, 128) if on_tpu else (2, 64)
+    steps = 10 if on_tpu else 2
+
+    h = amp.initialize(opt_level="O2", loss_scale="dynamic")
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    scaler_state = h.init_state()
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                             cfg.vocab_size)
+    mask = jnp.ones((batch, seq), jnp.int32)
+
+    def loss_fn(p):
+        out = apply_bert(p, cfg, ids, mask)
+        return mlm_loss(out["mlm_logits"], ids, mask)
+
+    @jax.jit
+    def train_step(master, opt_state, scaler_state):
+        p = h.cast_model(master)
+        loss, grads, found_inf, scaler_state = h.value_and_grad(loss_fn)(
+            p, scaler_state)
+        master, opt_state = opt.step(grads, master, opt_state,
+                                     found_inf=found_inf)
+        return master, opt_state, scaler_state, loss
+
+    # compile + warmup
+    params, opt_state, scaler_state, loss = train_step(
+        params, opt_state, scaler_state)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, scaler_state, loss = train_step(
+            params, opt_state, scaler_state)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch / dt
+    prev = None
+    runs = sorted(glob.glob(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r*.json")))
+    if runs:
+        try:
+            prev = json.load(open(runs[-1])).get("value")
+        except Exception:
+            prev = None
+    vs = (samples_per_sec / prev) if prev else None
+
+    print(json.dumps({
+        "metric": "bert_large_pretrain_step_amp_O2_fused_adam"
+                  if on_tpu else "bert_tiny_cpu_smoke",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3) if vs else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -87,11 +87,25 @@ def test_multi_tensor_axpby():
     assert not bool(flag)
 
 
-def test_applier_shim():
+def test_applier_shim_apex_convention():
     applier = MultiTensorApply(2048)
-    ts = [jnp.ones((4,))]
-    out, flag = applier("scale", None, [ts], 2.0)
+    # apex: multi_tensor_applier(scale_op, noop_buf, [src, dst], scale) —
+    # dst supplies the out dtypes, results are returned
+    src = [jnp.ones((4,), jnp.bfloat16)]
+    dst = [jnp.zeros((4,), jnp.float32)]
+    out, flag = applier("scale", None, [src, dst], 2.0)
+    assert out[0].dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(out[0]), np.full(4, 2.0))
+    assert not bool(flag)
+
+    # apex: applier(axpby_op, noop, [xs, ys, outs], a, b, ...)
+    xs, ys = [jnp.ones((4,))], [jnp.full((4,), 2.0)]
+    out, _ = applier("axpby", None, [xs, ys, ys], 3.0, 1.0)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(4, 5.0))
+
+    # single-list form still works for l2norm
+    total = applier("l2norm", None, [[jnp.full((4,), 2.0)]])
+    np.testing.assert_allclose(float(total), 4.0)
 
 
 # -- flat Pallas kernels ----------------------------------------------------
