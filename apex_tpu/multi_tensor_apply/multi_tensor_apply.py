@@ -45,13 +45,16 @@ def multi_tensor_scale(tensors: Sequence[jax.Array], scale,
     return out, found_inf
 
 
-def multi_tensor_axpby(a, xs: Sequence[jax.Array], b, ys: Sequence[jax.Array]
-                       ) -> Tuple[List[jax.Array], jax.Array]:
-    """a*x + b*y per pair — ref ``amp_C.multi_tensor_axpby``."""
+def multi_tensor_axpby(a, xs: Sequence[jax.Array], b, ys: Sequence[jax.Array],
+                       out_dtypes=None) -> Tuple[List[jax.Array], jax.Array]:
+    """a*x + b*y per pair — ref ``amp_C.multi_tensor_axpby``. ``out_dtypes``
+    (from the apex out-tensor list) selects result dtypes, default y's."""
     a = jnp.asarray(a, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
-    out = [(a * x.astype(jnp.float32) + b * y.astype(jnp.float32)).astype(y.dtype)
-           for x, y in zip(xs, ys)]
+    if out_dtypes is None:
+        out_dtypes = [y.dtype for y in ys]
+    out = [(a * x.astype(jnp.float32) + b * y.astype(jnp.float32)).astype(d)
+           for x, y, d in zip(xs, ys, out_dtypes)]
     return out, _found_inf(out)
 
 
@@ -91,9 +94,10 @@ class MultiTensorApply:
             out_dtypes = [t.dtype for t in rest[0]] if rest else None
             return multi_tensor_scale(src, args[0], out_dtypes)
         if op == "axpby":
-            xs, ys = tensor_lists[0], tensor_lists[1]
+            xs, ys, *rest = tensor_lists
             a, b = args[0], args[1]
-            return multi_tensor_axpby(a, xs, b, ys)
+            out_dtypes = [t.dtype for t in rest[0]] if rest else None
+            return multi_tensor_axpby(a, xs, b, ys, out_dtypes)
         if op == "l2norm":
             return multi_tensor_l2norm(tensor_lists[0], *args)
         raise ValueError(f"unknown multi-tensor op: {op!r}")

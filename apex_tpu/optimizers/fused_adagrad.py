@@ -29,12 +29,13 @@ class FusedAdagrad:
                             sum=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: AdagradState, *,
-             lr=None, grad_scale=1.0,
+             lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, AdagradState]:
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
-        eps, wd = f32(self.eps), f32(self.weight_decay)
+        eps = f32(self.eps)
+        wd = f32(self.weight_decay if weight_decay is None else weight_decay)
 
         def upd(g, p, s):
             g = g.astype(jnp.float32) * gs

@@ -51,11 +51,18 @@ class FusedAdam:
         # several param trees (init called more than once)
         self._specs = {}
 
+    @staticmethod
+    def _layout_key(leaves, treedef):
+        # treedef alone does not capture leaf shapes — same-structure trees
+        # with different shapes must not share a FlatSpec
+        return (treedef, tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
+
     def init(self, params: Any) -> AdamState:
         step = jnp.zeros((), jnp.int32)
         if self.use_flat_kernel:
-            buf, spec, treedef = _flatten.flatten_pytree(params, jnp.float32)
-            self._specs[treedef] = spec
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            buf, spec = _flatten.flatten_tensors(leaves, dtype=jnp.float32)
+            self._specs[self._layout_key(leaves, treedef)] = spec
             return AdamState(step=step, m=jnp.zeros_like(buf),
                              v=jnp.zeros_like(buf))
         return AdamState(step=step, m=tree_zeros_f32(params),
@@ -112,9 +119,10 @@ class FusedAdam:
 
     def _flat_step(self, grads, params, state, lr, wd, t, grad_scale):
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        spec = self._specs.get(treedef)
+        key = self._layout_key(leaves, treedef)
+        spec = self._specs.get(key)
         if spec is None:
-            spec = self._specs[treedef] = _flatten.make_spec(leaves)
+            spec = self._specs[key] = _flatten.make_spec(leaves)
         gbuf, _ = _flatten.flatten_tensors(
             jax.tree_util.tree_leaves(grads), spec)
         pbuf, _ = _flatten.flatten_tensors(leaves, spec)

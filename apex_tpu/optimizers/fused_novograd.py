@@ -49,13 +49,13 @@ class FusedNovoGrad:
             v=jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params))
 
     def step(self, grads: Any, params: Any, state: NovoGradState, *,
-             lr=None, grad_scale=1.0,
+             lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, NovoGradState]:
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
-        b1, b2, eps, wd = f32(self.beta1), f32(self.beta2), f32(self.eps), \
-            f32(self.weight_decay)
+        b1, b2, eps = f32(self.beta1), f32(self.beta2), f32(self.eps)
+        wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1
         tf = t.astype(jnp.float32)
         first = (state.step == 0)

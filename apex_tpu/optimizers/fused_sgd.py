@@ -40,13 +40,13 @@ class FusedSGD:
                         momentum_buf=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: SGDState, *,
-             lr=None, grad_scale=1.0,
+             lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, SGDState]:
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
-        mom, damp, wd = f32(self.momentum), f32(self.dampening), \
-            f32(self.weight_decay)
+        mom, damp = f32(self.momentum), f32(self.dampening)
+        wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1
         first = (state.step == 0)
 

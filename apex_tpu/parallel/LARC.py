@@ -1,0 +1,55 @@
+"""LARC — layer-wise adaptive rate control.
+
+Reference: ``apex/parallel/LARC.py :: class LARC`` wraps any optimizer and
+rescales each param's gradient so the effective layer lr is
+``trust_coefficient * ||p|| / (||g|| + weight_decay * ||p||)`` (clipped at
+the base lr when ``clip=True``). Same contract here: wrap one of the fused
+optimizers; grads are rescaled per leaf, then the inner optimizer steps.
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import f32
+
+
+class LARC:
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params: Any):
+        return self.optim.init(params)
+
+    def step(self, grads: Any, params: Any, state, *, lr=None,
+             weight_decay=None, found_inf=None, **kw) -> Tuple[Any, Any]:
+        base_lr = f32(lr if lr is not None else self.optim.lr)
+        wd = f32(weight_decay if weight_decay is not None
+                 else getattr(self.optim, "weight_decay", 0.0))
+        tc, eps = f32(self.trust_coefficient), f32(self.eps)
+
+        def rescale(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            adaptive_lr = tc * p_norm / (g_norm + p_norm * wd + eps)
+            # reference: zero norms leave the lr unchanged
+            adaptive_lr = jnp.where((p_norm > 0) & (g_norm > 0),
+                                    adaptive_lr, base_lr)
+            if self.clip:
+                adaptive_lr = jnp.minimum(adaptive_lr / base_lr, 1.0)
+            else:
+                adaptive_lr = adaptive_lr / base_lr
+            # reference folds the decay into the grad BEFORE rescaling (so
+            # decay is also trust-ratio-scaled) and zeroes the group's wd
+            return ((g32 + wd * p32) * adaptive_lr).astype(g.dtype)
+
+        grads = jax.tree.map(rescale, grads, params)
+        return self.optim.step(grads, params, state, lr=lr,
+                               weight_decay=0.0, found_inf=found_inf, **kw)

@@ -1,5 +1,13 @@
-"""Data-parallel utilities: DDP semantics, SyncBatchNorm, LARC.
+"""Data-parallel utilities (ref: ``apex/parallel/__init__.py``).
 
-Reference: ``apex/parallel/__init__.py``. Populated by the data-parallel
-build phase.
+``DistributedDataParallel`` (grad psum over the mesh ``data`` axis),
+``SyncBatchNorm`` (+``convert_syncbn_model``), ``LARC``, and the
+multi-host bootstrap in ``multiproc``.
 """
+
+from apex_tpu.parallel.distributed import DistributedDataParallel  # noqa: F401
+from apex_tpu.parallel.LARC import LARC  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)

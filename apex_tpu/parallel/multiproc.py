@@ -1,0 +1,39 @@
+"""Multi-host bootstrap.
+
+Reference: ``apex/parallel/multiproc.py`` — a pre-torchrun one-node
+process launcher (one process per GPU). JAX on TPU is single-controller
+per host and multi-host jobs rendezvous through
+``jax.distributed.initialize``; there is nothing to fork locally. This
+module keeps the entry point and maps it onto the JAX bootstrap.
+
+Usage (one invocation per host, e.g. under a pod launcher)::
+
+    python -m apex_tpu.parallel.multiproc train.py --args...
+"""
+
+import runpy
+import sys
+
+import jax
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Initialize multi-host JAX (env-driven when args are None)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    initialize_distributed()
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

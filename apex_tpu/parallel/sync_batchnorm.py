@@ -1,0 +1,75 @@
+"""SyncBatchNorm — cross-replica batch normalization.
+
+Reference: ``apex/parallel/sync_batchnorm.py`` + ``optimized_sync_batchnorm*``
+(CUDA ``welford`` kernels in ``csrc/welford.cu``): per-GPU partial Welford
+stats, allreduced across the process group, then normalization.
+
+TPU version: per-shard mean/mean-of-squares reduced with ``lax.pmean`` over
+the mesh ``data`` axis (XLA's allreduce over ICI) — the two-pass Welford
+combine collapses into one fused reduction. Runs inside shard_map/pmap;
+outside any mapped axis it degrades to plain BatchNorm exactly as the
+reference does in a single-process run.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from apex_tpu.models import layers as L
+from apex_tpu.transformer import parallel_state as ps
+
+
+class SyncBatchNorm:
+    """Module-shaped functional SyncBN (channel-last).
+
+    ``process_group`` of the reference becomes a mesh ``axis_name``.
+    ``init() -> (params, running_state)``;
+    ``apply(params, state, x, train=...) -> (y, new_state)``.
+    """
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True,
+                 axis_name: Optional[str] = None,
+                 channel_last: bool = True):
+        # ``momentum`` follows the torch/apex convention (UPDATE fraction,
+        # default 0.1): running = (1 - momentum) * running + momentum * batch.
+        # layers.batchnorm takes the keep fraction, so it receives
+        # ``1 - momentum``.
+        if not channel_last:
+            raise NotImplementedError(
+                "TPU layout is NHWC/channel-last; transpose inputs instead")
+        if not affine or not track_running_stats:
+            raise NotImplementedError(
+                "affine=False / track_running_stats=False not supported yet")
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.axis_name = axis_name if axis_name is not None else ps.DATA_AXIS
+
+    def init(self) -> Tuple[Dict, Dict]:
+        return L.init_batchnorm(self.num_features)
+
+    def apply(self, params: Dict, state: Dict, x: jax.Array, *,
+              train: bool = True) -> Tuple[jax.Array, Dict]:
+        return L.batchnorm(params, state, x, train=train,
+                           momentum=1.0 - self.momentum, eps=self.eps,
+                           axis_name=self.axis_name if train else None)
+
+    __call__ = apply
+
+
+def convert_syncbn_model(apply_fn, axis_name: Optional[str] = None,
+                         **partial_kwargs):
+    """Reference: ``apex/parallel/__init__.py :: convert_syncbn_model``
+    walks a module tree replacing BatchNorm with SyncBatchNorm. Functional
+    translation: the model zoo's apply functions thread an ``axis_name``
+    into every BatchNorm, so conversion = binding that argument.
+
+        sync_apply = convert_syncbn_model(apply_resnet)   # BN -> SyncBN
+        logits, stats = sync_apply(params, stats, x, train=True)
+    """
+    import functools
+
+    return functools.partial(
+        apply_fn, axis_name=axis_name or ps.DATA_AXIS, **partial_kwargs)

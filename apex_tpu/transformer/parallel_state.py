@@ -128,6 +128,26 @@ def get_mesh() -> Mesh:
     return _MESH
 
 
+def shard_map(f, *, mesh: Optional[Mesh] = None, in_specs, out_specs,
+              **kwargs):
+    """``jax.shard_map`` over the global mesh with ``check_vma=False``.
+
+    Two reasons this wrapper exists (use it for every mapped region in
+    this package):
+
+    - Pallas kernels in interpreter mode (the CPU test rig) reject mixed
+      varying/unvarying operands under ``check_vma=True`` (JAX's own error
+      suggests disabling it).
+    - ``check_vma=False`` restores the classic semantics where ``jax.grad``
+      inside the body yields LOCAL gradients (no implicit cross-axis psum
+      for replicated params) — the torch model the reference's DDP and TP
+      layers are written against; collectives stay explicit.
+    """
+    kwargs.setdefault("check_vma", False)
+    return jax.shard_map(f, mesh=mesh or get_mesh(), in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)
+
+
 # ---------------------------------------------------------------------------
 # "Groups" — axis names.
 # ---------------------------------------------------------------------------

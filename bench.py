@@ -66,19 +66,24 @@ def main():
     dt = time.perf_counter() - t0
 
     samples_per_sec = steps * batch / dt
+    metric = ("bert_large_pretrain_step_amp_O2_fused_adam"
+              if on_tpu else "bert_tiny_cpu_smoke")
     prev = None
     runs = sorted(glob.glob(os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "BENCH_r*.json")))
     if runs:
         try:
-            prev = json.load(open(runs[-1])).get("value")
+            rec = json.load(open(runs[-1]))
+            # only compare like with like (a CPU smoke run must not be
+            # ratioed against a TPU number)
+            if rec.get("metric") == metric:
+                prev = rec.get("value")
         except Exception:
             prev = None
     vs = (samples_per_sec / prev) if prev else None
 
     print(json.dumps({
-        "metric": "bert_large_pretrain_step_amp_O2_fused_adam"
-                  if on_tpu else "bert_tiny_cpu_smoke",
+        "metric": metric,
         "value": round(samples_per_sec, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 3) if vs else None,
