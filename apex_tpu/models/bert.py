@@ -108,17 +108,19 @@ def _ln(p, x, eps):
 
 
 def _attention(p, cfg: BertConfig, x, mask, dropout_rng=None):
+    from apex_tpu.transformer.functional import scaled_masked_softmax
+
     b, s, h = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
     qkv = L.dense(p["qkv"], x).reshape(b, s, 3, nh, hd)
     q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
-    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32)
-    scores = scores / math.sqrt(hd)
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k)
     if mask is not None:
-        # mask: (b, s) with 1 = attend; additive -inf on padding
-        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
-        scores = scores + bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        # mask: (b, s) with 1 = attend; the fused kernel masks nonzero
+        inv = (1 - mask)[:, None, None, :]
+    else:
+        inv = jnp.zeros((b, 1, 1, s), jnp.int32)
+    probs = scaled_masked_softmax(scores, inv, 1.0 / math.sqrt(hd))
     if dropout_rng is not None and cfg.attention_dropout > 0:
         keep = jax.random.bernoulli(dropout_rng, 1 - cfg.attention_dropout,
                                     probs.shape)

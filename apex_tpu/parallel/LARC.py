@@ -39,16 +39,20 @@ class LARC:
             p_norm = jnp.sqrt(jnp.sum(p32 * p32))
             g_norm = jnp.sqrt(jnp.sum(g32 * g32))
             adaptive_lr = tc * p_norm / (g_norm + p_norm * wd + eps)
-            # reference: zero norms leave the lr unchanged
-            adaptive_lr = jnp.where((p_norm > 0) & (g_norm > 0),
-                                    adaptive_lr, base_lr)
             if self.clip:
-                adaptive_lr = jnp.minimum(adaptive_lr / base_lr, 1.0)
+                # effective layer lr = min(adaptive, base): grads scaled by
+                # min(adaptive/base, 1), inner step applies base
+                factor = jnp.minimum(adaptive_lr / base_lr, 1.0)
             else:
-                adaptive_lr = adaptive_lr / base_lr
+                # effective layer lr = base * adaptive (reference multiplies
+                # the grad by adaptive_lr directly)
+                factor = adaptive_lr
             # reference folds the decay into the grad BEFORE rescaling (so
-            # decay is also trust-ratio-scaled) and zeroes the group's wd
-            return ((g32 + wd * p32) * adaptive_lr).astype(g.dtype)
+            # decay is also trust-ratio-scaled) and zeroes the group's wd;
+            # zero-norm leaves (frozen/unused) are left COMPLETELY untouched
+            nonzero = (p_norm > 0) & (g_norm > 0)
+            return jnp.where(nonzero,
+                             (g32 + wd * p32) * factor, g32).astype(g.dtype)
 
         grads = jax.tree.map(rescale, grads, params)
         return self.optim.step(grads, params, state, lr=lr,

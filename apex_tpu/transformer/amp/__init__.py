@@ -1,0 +1,26 @@
+"""Grad scaler for TP/PP training (ref: ``apex/transformer/amp/grad_scaler.py``
+— a Megatron-style GradScaler whose found_inf is allreduced across the
+model-parallel group). The core ``LossScaler`` is shared with ``apex_tpu.amp``;
+this wrapper adds the cross-rank OR of found_inf."""
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState  # noqa: F401
+from apex_tpu.transformer import parallel_state as ps
+
+
+class GradScaler(LossScaler):
+    """``unscale`` additionally ORs found_inf over the TP (and pipe) axes —
+    a rank that overflowed must make EVERY rank skip the step (the
+    reference allreduces found_inf over the model-parallel group). Call
+    inside shard_map."""
+
+    def unscale(self, grads: Any, state: LossScalerState
+                ) -> Tuple[Any, jnp.ndarray]:
+        grads, found_inf = super().unscale(grads, state)
+        for axis in (ps.TENSOR_AXIS, ps.PIPE_AXIS):
+            found_inf = lax.pmax(found_inf.astype(jnp.int32), axis) > 0
+        return grads, found_inf

@@ -1,0 +1,7 @@
+"""Fused functional ops (ref: ``apex/transformer/functional``)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)

@@ -1,0 +1,14 @@
+"""LayerNorm re-export (ref: ``apex/transformer/layers/layer_norm.py``
+bridges to ``fast_layer_norm`` when the hidden size has a persist kernel,
+else ``fused_layer_norm``; on TPU there is one seqlen-generic Pallas LN, so
+both names resolve to it)."""
+
+from apex_tpu.normalization import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+
+# the reference's persist-kernel alias
+FastLayerNorm = FusedLayerNorm

@@ -1,0 +1,71 @@
+"""Vocab-parallel cross entropy.
+
+Reference: ``apex/transformer/tensor_parallel/cross_entropy.py ::
+_VocabParallelCrossEntropy`` — logits arrive sharded on the vocab (last)
+dim; the loss is computed with two allreduces (max, sum-exp) plus a masked
+gather of the target logit from the owning shard, never materializing the
+full softmax. Backward is (softmax - one_hot) computed shard-locally.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer import parallel_state as ps
+
+_AXIS = ps.TENSOR_AXIS
+
+
+def _fwd_core(logits, target):
+    """Returns (loss, (softmax_local, target_mask, target_local))."""
+    per_rank = logits.shape[-1]
+    rank = lax.axis_index(_AXIS)
+    start = rank * per_rank
+
+    # allreduce #1: global max for stability
+    lmax = lax.pmax(jnp.max(logits, axis=-1), _AXIS)
+    shifted = logits - lmax[..., None]
+    exp = jnp.exp(shifted)
+    # allreduce #2: global sum-exp
+    sum_exp = lax.psum(jnp.sum(exp, axis=-1), _AXIS)
+
+    # target logit: owning shard contributes, others add zero
+    local = target - start
+    in_range = (local >= 0) & (local < per_rank)
+    safe = jnp.where(in_range, local, 0)
+    tgt_shifted = jnp.take_along_axis(shifted, safe[..., None], axis=-1)[..., 0]
+    tgt_shifted = jnp.where(in_range, tgt_shifted, 0.0)
+    tgt_shifted = lax.psum(tgt_shifted, _AXIS)
+
+    loss = jnp.log(sum_exp) - tgt_shifted
+    softmax_local = exp / sum_exp[..., None]
+    return loss, (softmax_local, in_range, safe)
+
+
+@jax.custom_vjp
+def vocab_parallel_cross_entropy(logits, target):
+    """Per-token loss (same shape as ``target``); call inside shard_map
+    with logits sharded over the vocab dim."""
+    loss, _ = _fwd_core(logits.astype(jnp.float32), target)
+    return loss
+
+
+def _vce_fwd(logits, target):
+    loss, res = _fwd_core(logits.astype(jnp.float32), target)
+    # zero-size sentinel carries the logits dtype (dtypes are not pytree
+    # leaves)
+    return loss, (res, jnp.zeros((0,), logits.dtype))
+
+
+def _vce_bwd(resdt, g):
+    # d logits = (softmax - one_hot(target)) * g, shard-locally: the
+    # one-hot only lands on the owning rank's slice
+    (softmax_local, in_range, safe), dtype_sentinel = resdt
+    onehot = jax.nn.one_hot(safe, softmax_local.shape[-1],
+                            dtype=softmax_local.dtype)
+    onehot = onehot * jnp.where(in_range, 1.0, 0.0)[..., None]
+    grad = softmax_local - onehot
+    return (grad * g[..., None]).astype(dtype_sentinel.dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)

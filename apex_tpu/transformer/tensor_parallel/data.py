@@ -1,0 +1,33 @@
+"""Batch broadcast across the TP group.
+
+Reference: ``apex/transformer/tensor_parallel/data.py :: broadcast_data`` —
+TP rank 0 loads the batch and broadcasts it (keys/dtype/shape handshake +
+flatten + NCCL broadcast). On a mesh: a masked psum from index 0 of the
+model axis; shapes/dtypes are static under jit so no handshake exists.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer import parallel_state as ps
+
+_AXIS = ps.TENSOR_AXIS
+
+
+def broadcast_data(keys, data: dict, datatype=None) -> dict:
+    """Broadcast ``{k: array}`` from TP rank 0 (call inside shard_map).
+
+    ``keys`` selects which entries to broadcast; ``datatype`` optionally
+    casts (the reference asserts a single dtype instead)."""
+    rank = lax.axis_index(_AXIS)
+    out = {}
+    for k in keys:
+        v = data[k]
+        if datatype is not None:
+            v = v.astype(datatype)
+        masked = jnp.where(rank == 0, v, jnp.zeros_like(v))
+        out[k] = lax.psum(masked, _AXIS)
+    return out

@@ -1,0 +1,186 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: ``apex/transformer/tensor_parallel/layers.py`` —
+``ColumnParallelLinear`` (weight sharded on the output dim),
+``RowParallelLinear`` (input dim), ``VocabParallelEmbedding`` (vocab-range
+shard + allreduce), plus ``linear_with_grad_accumulation_and_async_allreduce``
+(the ``gradient_accumulation_fusion`` wgrad path backed by
+``fused_weight_gradient_mlp_cuda``).
+
+Execution model: ``init`` builds FULL (unsharded) params on the host;
+``apply`` runs INSIDE ``parallel_state.shard_map`` where each rank sees its
+LOCAL shard (the shard_map in_specs — from ``partition_specs()`` — do the
+splitting; GSPMD keeps the global array sharded at rest). Async-overlapped
+grad allreduce and wgrad-accumulation fusion fall out of XLA's scheduler
+rather than hand-rolled CUDA streams.
+
+Sequence parallelism (``sequence_parallel_enabled``) follows the reference:
+activations outside TP regions are sharded along the SEQUENCE dim (axis 0,
+Megatron (s, b, h) layout) over the SAME model axis; Column gathers (fwd) /
+reduce-scatters (bwd), Row reduce-scatters (fwd) / gathers (bwd).
+"""
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.utils.math import divide
+
+_AXIS = ps.TENSOR_AXIS
+
+
+def _init_kernel(key, shape, dtype):
+    fan_in = shape[0]
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+
+
+class ColumnParallelLinear:
+    """Y = X @ A + b with A sharded column-wise: A = [A_1 .. A_p].
+
+    ``gather_output=True`` all-gathers Y (each rank then holds the full
+    output); otherwise the output stays sharded for a following
+    RowParallelLinear.
+    """
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, gather_output: bool = True,
+                 sequence_parallel_enabled: bool = False,
+                 params_dtype=jnp.float32, tp_size: Optional[int] = None):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.gather_output = gather_output
+        self.sequence_parallel_enabled = sequence_parallel_enabled
+        self.params_dtype = params_dtype
+        if sequence_parallel_enabled and gather_output:
+            raise ValueError(
+                "sequence_parallel_enabled requires gather_output=False "
+                "(the reference asserts the same)")
+        # divisibility check against the mesh (init-time world size)
+        tp = tp_size if tp_size is not None else \
+            ps.get_tensor_model_parallel_world_size()
+        divide(out_features, tp)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        p = {"kernel": _init_kernel(
+            key, (self.in_features, self.out_features), self.params_dtype)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.out_features,), self.params_dtype)
+        return p
+
+    def partition_specs(self) -> Dict[str, P]:
+        s = {"kernel": P(None, _AXIS)}
+        if self.use_bias:
+            s["bias"] = P(_AXIS)
+        return s
+
+    def apply(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        if self.sequence_parallel_enabled:
+            # x arrives seq-sharded; gather the full sequence for the GEMM
+            # (bwd: reduce-scatter)
+            x = mappings.gather_from_sequence_parallel_region(x, True)
+        else:
+            # fwd identity / bwd allreduce of dX across TP ranks
+            x = mappings.copy_to_tensor_model_parallel_region(x)
+        y = jnp.dot(x, params["kernel"].astype(x.dtype))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.gather_output:
+            y = mappings.gather_from_tensor_model_parallel_region(y)
+        return y
+
+    __call__ = apply
+
+
+class RowParallelLinear:
+    """Y = X @ A + b with A sharded row-wise; X arrives split along its
+    last dim (``input_is_parallel``, the output of a Column layer)."""
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, input_is_parallel: bool = True,
+                 sequence_parallel_enabled: bool = False,
+                 params_dtype=jnp.float32, tp_size: Optional[int] = None):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.input_is_parallel = input_is_parallel
+        self.sequence_parallel_enabled = sequence_parallel_enabled
+        self.params_dtype = params_dtype
+        if sequence_parallel_enabled and not input_is_parallel:
+            raise ValueError(
+                "sequence_parallel_enabled requires input_is_parallel")
+        tp = tp_size if tp_size is not None else \
+            ps.get_tensor_model_parallel_world_size()
+        divide(in_features, tp)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        p = {"kernel": _init_kernel(
+            key, (self.in_features, self.out_features), self.params_dtype)}
+        if self.use_bias:
+            # bias is applied AFTER the reduction, replicated (ref keeps it
+            # unsharded and adds on every rank post-allreduce)
+            p["bias"] = jnp.zeros((self.out_features,), self.params_dtype)
+        return p
+
+    def partition_specs(self) -> Dict[str, P]:
+        s = {"kernel": P(_AXIS, None)}
+        if self.use_bias:
+            s["bias"] = P()
+        return s
+
+    def apply(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        if not self.input_is_parallel:
+            x = mappings.scatter_to_tensor_model_parallel_region(x)
+        y = jnp.dot(x, params["kernel"].astype(x.dtype))
+        if self.sequence_parallel_enabled:
+            y = mappings.reduce_scatter_to_sequence_parallel_region(y)
+        else:
+            y = mappings.reduce_from_tensor_model_parallel_region(y)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+    __call__ = apply
+
+
+class VocabParallelEmbedding:
+    """Embedding with the vocab dim sharded across TP ranks: each rank owns
+    rows [rank·V/p, (rank+1)·V/p); out-of-range ids contribute zeros and
+    the partial lookups are summed with psum."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 params_dtype=jnp.float32, tp_size: Optional[int] = None):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.params_dtype = params_dtype
+        tp = tp_size if tp_size is not None else \
+            ps.get_tensor_model_parallel_world_size()
+        divide(num_embeddings, tp)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        return {"embedding": jax.random.normal(
+            key, (self.num_embeddings, self.embedding_dim),
+            self.params_dtype) * 0.02}
+
+    def partition_specs(self) -> Dict[str, P]:
+        return {"embedding": P(_AXIS, None)}
+
+    def apply(self, params: Dict[str, Any], ids: jax.Array) -> jax.Array:
+        table = params["embedding"]          # local shard (V/p, H)
+        per_rank = table.shape[0]
+        rank = lax.axis_index(_AXIS)
+        start = rank * per_rank
+        local = ids - start
+        in_range = (local >= 0) & (local < per_rank)
+        safe = jnp.where(in_range, local, 0)
+        out = jnp.take(table, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0)
+        return mappings.reduce_from_tensor_model_parallel_region(out)
+
+    __call__ = apply

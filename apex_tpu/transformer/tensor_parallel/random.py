@@ -1,0 +1,100 @@
+"""RNG state management + activation checkpointing for model parallelism.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` keeps separate CUDA RNG streams so dropout inside
+TP regions differs per rank while data-parallel regions agree;
+``CheckpointFunction`` re-runs forward with saved/restored RNG states.
+
+JAX has no mutable RNG streams: keys are values. The tracker API survives
+as key derivation —
+
+- ``model_parallel_rng_key(key)``: fold the TP rank in (dropout DIFFERS
+  per TP rank — sharded activations need decorrelated masks);
+- ``data_parallel_rng_key(key)``: fold nothing (replicated regions agree
+  by construction, matching the reference's default stream).
+
+``checkpoint`` is ``jax.checkpoint``: rematerialization replays the traced
+computation with the SAME key values, so the save/restore dance is free.
+"""
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.transformer import parallel_state as ps
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"  # tracker name in the reference
+
+
+def model_parallel_rng_key(key: jax.Array) -> jax.Array:
+    """Per-TP-rank key (ref: ``get_cuda_rng_tracker().fork()``); call
+    inside shard_map."""
+    return jax.random.fold_in(key, lax.axis_index(ps.TENSOR_AXIS))
+
+
+def data_parallel_rng_key(key: jax.Array) -> jax.Array:
+    """Key shared by all TP ranks (the reference's default stream)."""
+    return key
+
+
+def model_parallel_seed(seed: int) -> dict:
+    """Mirror of ``model_parallel_cuda_manual_seed(seed)``: returns the two
+    base keys the reference derives (data-parallel seed, model-parallel
+    seed offset by 2718)."""
+    return {
+        "data_parallel": jax.random.PRNGKey(seed),
+        "model_parallel": jax.random.PRNGKey(seed + 2718),
+    }
+
+
+class RNGStatesTracker:
+    """API-shaped shim over key folding (ref: ``CudaRNGStatesTracker``).
+
+    ``fork(name)`` returns a derived key instead of a context manager —
+    functional code passes keys explicitly."""
+
+    def __init__(self):
+        self._keys = {}
+
+    def add(self, name: str, seed: int) -> None:
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    def get_states(self) -> dict:
+        return dict(self._keys)
+
+    def set_states(self, states: dict) -> None:
+        self._keys = dict(states)
+
+    def reset(self) -> None:
+        self._keys = {}
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG) -> jax.Array:
+        """Split off a fresh key (host-side). Inside shard_map, apply
+        ``model_parallel_rng_key`` to the result to decorrelate TP ranks
+        (the fold needs a bound mesh axis)."""
+        key = self._keys[name]
+        self._keys[name], sub = jax.random.split(key)
+        return sub
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """ref: ``get_cuda_rng_tracker`` (renamed: nothing CUDA about it)."""
+    return _TRACKER
+
+
+# Activation checkpointing: rematerialize in backward. RNG keys replay
+# identically because they are values (ref CheckpointFunction's RNG
+# save/restore is structural here).
+checkpoint = jax.checkpoint
+
+
+def checkpoint_policy(save_dots: bool = False):
+    """Common remat policies: ``save_dots`` keeps matmul outputs (the
+    reference's selective activation checkpointing analogue)."""
+    if save_dots:
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint_policies.nothing_saveable

@@ -1,0 +1,34 @@
+"""Shard-math helpers (ref: ``apex/transformer/tensor_parallel/utils.py``)."""
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.math import divide
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int,
+                                contiguous_split_chunks: bool = False):
+    """Split along the last dim (ref keeps a contiguity flag; moot here)."""
+    last = tensor.shape[-1]
+    size = divide(last, num_partitions)
+    return [tensor[..., i * size:(i + 1) * size]
+            for i in range(num_partitions)]
+
+
+class VocabUtility:
+    """Vocab range bookkeeping (ref: ``class VocabUtility``)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size: int, rank: int,
+            world_size: int) -> Tuple[int, int]:
+        f = rank * per_partition_vocab_size
+        return f, f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank: int,
+                                           world_size: int) -> Tuple[int, int]:
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)

@@ -146,12 +146,21 @@ def test_larc_clip_formula():
     want = 2.0 - 0.1 * 0.1 * ratio
     np.testing.assert_allclose(np.asarray(new_p["w"]), want, rtol=1e-5)
 
-    # unclipped (scale) mode actually rescales
+    # unclipped mode: effective layer lr = base_lr * adaptive (reference
+    # multiplies the grad by adaptive_lr, inner step applies base lr)
     larc2 = LARC(FusedSGD(lr=0.1, momentum=0.0), trust_coefficient=0.02,
                  clip=False)
     new_p2, _ = larc2.step(g, p, larc2.init(p))
-    want2 = 2.0 - 0.1 * 0.1 * (adaptive / 0.1)
+    want2 = 2.0 - 0.1 * adaptive * 0.1
     np.testing.assert_allclose(np.asarray(new_p2["w"]), want2, rtol=1e-4)
+
+    # zero-grad leaves are untouched even with weight decay (reference
+    # guards the wd fold behind nonzero norms)
+    larc3 = LARC(FusedSGD(lr=0.1, momentum=0.0, weight_decay=0.0),
+                 trust_coefficient=0.02, clip=True)
+    zg = {"w": jnp.zeros((4,))}
+    new_p3, _ = larc3.step(zg, p, larc3.init(p), weight_decay=0.5)
+    np.testing.assert_allclose(np.asarray(new_p3["w"]), 2.0)
 
 
 def test_ddp_bert_tiny_train_step():

@@ -1,0 +1,121 @@
+"""Fused softmax kernels vs jnp references (ref:
+``tests/L0/run_transformer/test_fused_softmax.py``-style golden tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import (
+    FusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+MASK_VAL = -10000.0
+
+
+def ref_masked(x, mask, scale):
+    z = x.astype(jnp.float32) * scale
+    z = jnp.where(mask != 0, MASK_VAL, z)
+    return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+
+
+def ref_causal(x, scale):
+    z = x.astype(jnp.float32) * scale
+    sq, sk = z.shape[-2:]
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    z = jnp.where(causal, z, MASK_VAL)
+    return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [(2, 4, 32, 32), (1, 2, 17, 40)])
+def test_scaled_masked_softmax_fwd(dtype, shape):
+    b, np_, sq, sk = shape
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, dtype) * 2
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(1), 0.3, (b, 1, sq, sk)).astype(jnp.int32)
+    got = scaled_masked_softmax(x, mask, 0.5)
+    want = ref_masked(x, jnp.broadcast_to(mask, shape), 0.5)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+
+
+def test_scaled_masked_softmax_grads():
+    shape = (2, 2, 16, 24)
+    x = jax.random.normal(jax.random.PRNGKey(0), shape) * 2
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(1), 0.2, (2, 1, 16, 24)).astype(jnp.int32)
+    dy = jax.random.normal(jax.random.PRNGKey(2), shape)
+
+    g = jax.grad(lambda x: jnp.sum(scaled_masked_softmax(x, mask, 0.7) * dy))(x)
+    r = jax.grad(lambda x: jnp.sum(
+        ref_masked(x, jnp.broadcast_to(mask, shape), 0.7) * dy))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_scaled_upper_triang_softmax_fwd_bwd(dtype):
+    shape = (4, 24, 24)
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, dtype) * 2
+    got = scaled_upper_triang_masked_softmax(x, 1.3)
+    want = ref_causal(x, 1.3)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+    # strictly causal: everything above the diagonal ~ 0
+    assert float(jnp.max(jnp.triu(got.astype(jnp.float32), k=1))) < 1e-4
+
+    if dtype == jnp.float32:
+        dy = jax.random.normal(jax.random.PRNGKey(1), shape)
+        g = jax.grad(lambda x: jnp.sum(
+            scaled_upper_triang_masked_softmax(x, 1.3) * dy))(x)
+        r = jax.grad(lambda x: jnp.sum(ref_causal(x, 1.3) * dy))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_causal_4d_input():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 16, 16))
+    got = scaled_upper_triang_masked_softmax(x, 1.0)
+    want = ref_causal(x, 1.0)
+    assert got.shape == x.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dispatcher_fused_vs_fallback():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 16, 16),
+                          jnp.bfloat16)
+    mask = jax.random.bernoulli(
+        jax.random.PRNGKey(1), 0.2, (2, 1, 16, 16)).astype(jnp.int32)
+
+    fused = FusedScaleMaskSoftmax(input_in_bf16=True, scale=0.5,
+                                  scaled_masked_softmax_fusion=True)
+    fallback = FusedScaleMaskSoftmax(input_in_bf16=True, scale=0.5,
+                                     scaled_masked_softmax_fusion=False)
+    np.testing.assert_allclose(
+        np.asarray(fused(x, mask), np.float32),
+        np.asarray(fallback(x, mask), np.float32), rtol=2e-2, atol=2e-2)
+
+    causal_f = FusedScaleMaskSoftmax(input_in_bf16=True,
+                                     attn_mask_type=AttnMaskType.causal)
+    causal_n = FusedScaleMaskSoftmax(input_in_bf16=True,
+                                     attn_mask_type=AttnMaskType.causal,
+                                     scaled_masked_softmax_fusion=False)
+    np.testing.assert_allclose(
+        np.asarray(causal_f(x), np.float32),
+        np.asarray(causal_n(x), np.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_dispatcher_validation():
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(scale=2.0, softmax_in_fp32=False)
