@@ -1,0 +1,57 @@
+"""Driver-contract tests for ``__graft_entry__``.
+
+Round-1 postmortem (VERDICT.md "What's weak" #1): the driver imports the
+module and calls ``dryrun_multichip(8)`` directly — it does NOT run the
+``__main__`` block — and in r01 that path failed because the n-device CPU
+world was only configured under ``__main__``. These tests exercise the
+function exactly the way the driver does, in-process and in a fresh
+interpreter with a pre-initialized too-small backend.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_dryrun_multichip_in_process():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_entry_returns_jittable():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        import jax
+        fn, example_args = __graft_entry__.entry()
+        out = jax.jit(fn).lower(*example_args)  # compile-check only
+        assert out is not None
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_multichip_resets_small_world():
+    """Simulate the exact r01 failure: JAX already initialized with ONE
+    device when ``dryrun_multichip(8)`` is called. The function must tear
+    down and rebuild an 8-device world itself."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "import __graft_entry__\n"
+        "__graft_entry__.dryrun_multichip(8)\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "OK" in proc.stdout, proc.stdout
