@@ -7,6 +7,7 @@ tensor_parallel, pipeline_parallel and the AMP/functional helpers.
 from apex_tpu.transformer import functional  # noqa: F401
 from apex_tpu.transformer import layers  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer.enums import (  # noqa: F401
     AttnMaskType,

@@ -1,0 +1,319 @@
+"""Pipeline-parallel schedules over the ``pipe`` mesh axis.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/__init__.py ::
+get_forward_backward_func`` + ``fwd_bwd_no_pipelining.py``,
+``fwd_bwd_pipelining_without_interleaving.py`` (1F1B),
+``fwd_bwd_pipelining_with_interleaving.py`` (virtual/interleaved 1F1B).
+
+TPU-native redesign — the *collective pipeline*.  The reference drives
+each stage from host Python, posting NCCL p2p ops between ranks and
+invoking torch autograd per microbatch.  Under XLA's single-controller
+SPMD model the whole schedule is instead ONE jitted program:
+
+- stage parameters are **stacked on a leading axis and sharded over the
+  ``pipe`` mesh axis** (each device holds its stage's slice);
+- the microbatch loop is a ``lax.scan`` over "ticks"; at every tick each
+  device applies its stage and the activations rotate one stage forward
+  via ``lax.ppermute`` (see ``p2p_communication._shift``);
+- the backward pipeline is NOT hand-written: the schedule's forward is
+  differentiated with ``jax.value_and_grad``, and the transpose of a
+  ppermute-rotation scan *is* the reversed rotation scan — XLA's
+  latency-hiding scheduler overlaps the resulting collectives with
+  compute exactly where the reference overlaps NCCL with CUDA streams;
+- 1F1B's raison d'être — bounding live activation memory — is served by
+  ``jax.checkpoint`` around the per-tick stage application
+  (``checkpoint_stages=True``): live memory is one hidden state per tick
+  plus rematerialization, the analogue of the reference's
+  ``deallocate_output_tensor`` discipline.
+
+Bubble accounting: the plain schedule runs ``M + pp - 1`` ticks for
+``M`` microbatches — the same fill/drain bubble as 1F1B.  The
+interleaved schedule uses ``vpp`` lanes per device (virtual chunks
+round-robin over stages, chunk ``c`` on device ``c % pp``) and runs
+``M + pp*vpp - 1`` ticks; each tick computes all resident lanes, so in
+steady state utilization matches the reference while fill/drain is
+``vpp``× longer in tick-count (ticks are the same stage-size — see the
+module docstring of ``p2p_communication`` for why SPMD prefers uniform
+ticks).  Grads and losses are bit-for-bit the same math as the
+reference's schedules.
+
+Model contract (the functional analogue of the reference's
+``forward_step_func(batch, model)`` protocol):
+
+    model = PipelineModel(embed_fn, stage_fn, loss_fn)
+    params = {"embed": ..., "stages": <leaves stacked on a leading
+              stage axis>, "head": ...}
+
+- ``embed_fn(embed_params, microbatch) -> hidden`` — first-stage input.
+- ``stage_fn(one_stage_params, hidden) -> hidden`` — homogeneous body.
+- ``loss_fn(head_params, hidden, microbatch) -> scalar`` — last stage.
+
+For the pipelined schedules, call INSIDE ``parallel_state.shard_map``
+with in_specs ``P(PIPE_AXIS)`` on the leading axis of ``stages`` leaves
+(shape ``(pp, ...)``; interleaved: ``(vpp, pp, ...)`` with spec
+``P(None, PIPE_AXIS)``) and replicated embed/head/batch.  Returned
+grads match the (local) structure of ``params``; embed/head grads are
+psum'd over ``pipe`` so every stage holds the full value — the analogue
+of the reference's embedding-group allreduce.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import microbatches as mb_calc
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import _shift
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineModel:
+    embed_fn: Callable[[Pytree, Pytree], jax.Array]
+    stage_fn: Callable[[Pytree, jax.Array], jax.Array]
+    loss_fn: Callable[[Pytree, jax.Array, Pytree], jax.Array]
+
+
+def split_batch_into_microbatches(batch: Pytree,
+                                  num_microbatches: int) -> Pytree:
+    """(B, ...) leaves -> (M, B//M, ...) (ref: the schedules' batch
+    iterator; here a reshape so the microbatch loop can be a scan)."""
+    def split(a):
+        b = a.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch dim {b} not divisible by {num_microbatches} "
+                "microbatches")
+        return a.reshape((num_microbatches, b // num_microbatches)
+                         + a.shape[1:])
+    return jax.tree.map(split, batch)
+
+
+def _num_microbatches(num_microbatches: Optional[int]) -> int:
+    if num_microbatches is not None:
+        return int(num_microbatches)
+    return mb_calc.get_num_microbatches()
+
+
+def _stage_apply(model: PipelineModel, checkpoint_stages: bool):
+    fn = model.stage_fn
+    return jax.checkpoint(fn) if checkpoint_stages else fn
+
+
+# ---------------------------------------------------------------------------
+# no pipelining
+# ---------------------------------------------------------------------------
+
+def forward_backward_no_pipelining(
+    model: PipelineModel,
+    params: Dict[str, Pytree],
+    batch: Pytree,
+    *,
+    num_microbatches: Optional[int] = None,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+) -> Tuple[jax.Array, Optional[Pytree]]:
+    """Grad accumulation over microbatches, no pipe collectives
+    (ref: ``fwd_bwd_no_pipelining.py``). Usable with or without a mesh."""
+    M = _num_microbatches(num_microbatches)
+    mbs = split_batch_into_microbatches(batch, M)
+    stage = _stage_apply(model, checkpoint_stages)
+
+    def mb_loss(p, mb):
+        x = model.embed_fn(p["embed"], mb)
+        x, _ = lax.scan(lambda h, sp: (stage(sp, h), None), x, p["stages"])
+        return model.loss_fn(p["head"], x, mb)
+
+    zero = jnp.zeros((), jnp.float32)
+    if forward_only:
+        total, _ = lax.scan(
+            lambda acc, mb: (acc + mb_loss(params, mb), None), zero, mbs)
+        return total / M, None
+
+    vg = jax.value_and_grad(mb_loss)
+
+    def step(carry, mb):
+        tot, g = carry
+        loss, gi = vg(params, mb)
+        return (tot + loss, jax.tree.map(jnp.add, g, gi)), None
+
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    (total, grads), _ = lax.scan(step, (zero, zero_g), mbs)
+    grads = jax.tree.map(lambda a: (a / M).astype(a.dtype), grads)
+    return total / M, grads
+
+
+# ---------------------------------------------------------------------------
+# plain (non-interleaved) pipelining — 1F1B equivalent
+# ---------------------------------------------------------------------------
+
+def forward_backward_pipelining_without_interleaving(
+    model: PipelineModel,
+    params: Dict[str, Pytree],
+    batch: Pytree,
+    *,
+    num_microbatches: Optional[int] = None,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+) -> Tuple[jax.Array, Optional[Pytree]]:
+    """Collective 1F1B (ref: ``fwd_bwd_pipelining_without_interleaving``).
+
+    Call inside shard_map; ``params["stages"]`` leaves arrive as the
+    local ``(1, ...)`` slice of the ``(pp, ...)`` stack.
+    """
+    M = _num_microbatches(num_microbatches)
+    mbs = split_batch_into_microbatches(batch, M)
+    pp = lax.axis_size(ps.PIPE_AXIS)
+    d = lax.axis_index(ps.PIPE_AXIS)
+    stage = _stage_apply(model, checkpoint_stages)
+    T = M + pp - 1
+
+    def compute_loss(p):
+        stage_p = jax.tree.map(lambda a: a[0], p["stages"])
+        # embed every microbatch up-front, one big MXU-friendly batch op
+        # (computed on all stages; only stage 0's copy is consumed)
+        xs = jax.vmap(model.embed_fn, in_axes=(None, 0))(p["embed"], mbs)
+
+        def tick(carry, t):
+            state, outs = carry
+            inject = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(d == 0, inject, state)
+            y = stage(stage_p, x_in)
+            # last stage records microbatch t-(pp-1) (garbage elsewhere /
+            # out-of-range ticks are masked, not clip-written)
+            slot = jnp.clip(t - (pp - 1), 0, M - 1)
+            valid = t >= pp - 1
+            old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, old), slot, 0)
+            return (_shift(y, +1), outs), None
+
+        state0 = jnp.zeros_like(xs[0])
+        outs0 = jnp.zeros((M,) + state0.shape, state0.dtype)
+        (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
+
+        losses = jax.vmap(model.loss_fn, in_axes=(None, 0, 0))(
+            p["head"], outs, mbs)
+        local = losses.mean().astype(jnp.float32)
+        # only the last stage computed real losses — mask the others.
+        # NOTE: differentiate the MASKED LOCAL value, not its psum: AD of a
+        # per-rank output with unit cotangent computes grad of the sum over
+        # ranks, and the mask makes that sum count the loss exactly once
+        # (a psum here would transpose to another psum and scale every
+        # gradient by pp).
+        return jnp.where(d == pp - 1, local, 0.0)
+
+    if forward_only:
+        return lax.psum(compute_loss(params), ps.PIPE_AXIS), None
+    loss, grads = jax.value_and_grad(compute_loss)(params)
+    loss = lax.psum(loss, ps.PIPE_AXIS)
+    grads = dict(grads)
+    # embed grads live on stage 0 (injection), head grads on the last
+    # stage (loss mask): replicate both, ref's embedding-group allreduce
+    grads["embed"] = lax.psum(grads["embed"], ps.PIPE_AXIS)
+    grads["head"] = lax.psum(grads["head"], ps.PIPE_AXIS)
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
+# interleaved (virtual pipeline) — lanes of round-robin chunks
+# ---------------------------------------------------------------------------
+
+def forward_backward_pipelining_with_interleaving(
+    model: PipelineModel,
+    params: Dict[str, Pytree],
+    batch: Pytree,
+    *,
+    num_microbatches: Optional[int] = None,
+    forward_only: bool = False,
+    checkpoint_stages: bool = True,
+    virtual_pipeline_size: Optional[int] = None,
+) -> Tuple[jax.Array, Optional[Pytree]]:
+    """Interleaved schedule (ref: ``fwd_bwd_pipelining_with_interleaving``).
+
+    Model chunk ``c`` (of ``pp*vpp``) lives on device ``c % pp`` —
+    exactly the reference's round-robin assignment.  ``params["stages"]``
+    leaves arrive as the local ``(vpp, 1, ...)`` slice of a
+    ``(vpp, pp, ...)`` stack (``[l, dev]`` = chunk ``l*pp + dev``).
+    Each device keeps ``vpp`` activation lanes; lane ``l`` holds the
+    microbatch currently entering chunk ``l*pp + dev``.  One ppermute
+    per tick rotates all lanes; the first stage additionally rolls
+    lanes by one (a chunk boundary wraps from the last stage back to
+    the first).
+    """
+    vpp = virtual_pipeline_size or \
+        ps.get_virtual_pipeline_model_parallel_world_size()
+    if vpp is None or vpp < 1:
+        raise ValueError("interleaved schedule requires a virtual "
+                         "pipeline size (initialize_model_parallel("
+                         "virtual_pipeline_model_parallel_size_=...))")
+    M = _num_microbatches(num_microbatches)
+    mbs = split_batch_into_microbatches(batch, M)
+    pp = lax.axis_size(ps.PIPE_AXIS)
+    d = lax.axis_index(ps.PIPE_AXIS)
+    stage = _stage_apply(model, checkpoint_stages)
+    n_chunks = pp * vpp
+    T = M + n_chunks - 1
+
+    def compute_loss(p):
+        stage_p = jax.tree.map(lambda a: a[:, 0], p["stages"])  # (vpp, ...)
+        xs = jax.vmap(model.embed_fn, in_axes=(None, 0))(p["embed"], mbs)
+
+        def tick(carry, t):
+            lanes, outs = carry  # lanes: (vpp,) + hidden shape
+            inject = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            lane0 = jnp.where(d == 0, inject, lanes[0])
+            x_in = jnp.concatenate([lane0[None], lanes[1:]], axis=0)
+            ys = jax.vmap(stage)(stage_p, x_in)  # one chunk per lane
+            # chunk n_chunks-1 output = lane vpp-1 on the last stage
+            slot = jnp.clip(t - (n_chunks - 1), 0, M - 1)
+            valid = t >= n_chunks - 1
+            old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, ys[vpp - 1], old), slot, 0)
+            recv = _shift(ys, +1)
+            # wraparound chunk boundary: stage 0's lane l continues the
+            # work the last stage finished on lane l-1
+            lanes = jnp.where(d == 0, jnp.roll(recv, 1, axis=0), recv)
+            return (lanes, outs), None
+
+        hidden0 = jnp.zeros_like(xs[0])
+        lanes0 = jnp.zeros((vpp,) + hidden0.shape, hidden0.dtype)
+        outs0 = jnp.zeros((M,) + hidden0.shape, hidden0.dtype)
+        (_, outs), _ = lax.scan(tick, (lanes0, outs0), jnp.arange(T))
+
+        losses = jax.vmap(model.loss_fn, in_axes=(None, 0, 0))(
+            p["head"], outs, mbs)
+        local = losses.mean().astype(jnp.float32)
+        # masked local, NOT psum — see the non-interleaved schedule's note
+        return jnp.where(d == pp - 1, local, 0.0)
+
+    if forward_only:
+        return lax.psum(compute_loss(params), ps.PIPE_AXIS), None
+    loss, grads = jax.value_and_grad(compute_loss)(params)
+    loss = lax.psum(loss, ps.PIPE_AXIS)
+    grads = dict(grads)
+    grads["embed"] = lax.psum(grads["embed"], ps.PIPE_AXIS)
+    grads["head"] = lax.psum(grads["head"], ps.PIPE_AXIS)
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+def get_forward_backward_func() -> Callable[..., Tuple[jax.Array,
+                                                       Optional[Pytree]]]:
+    """Pick the schedule from the global parallel state (ref:
+    ``schedules/__init__.py :: get_forward_backward_func``)."""
+    if ps.get_pipeline_model_parallel_world_size() == 1:
+        return forward_backward_no_pipelining
+    if ps.get_virtual_pipeline_model_parallel_world_size() is not None:
+        return forward_backward_pipelining_with_interleaving
+    return forward_backward_pipelining_without_interleaving

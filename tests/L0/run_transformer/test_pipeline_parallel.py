@@ -1,0 +1,221 @@
+"""Pipeline-parallel schedule parity tests (8-device CPU mesh).
+
+Golden-model pattern (SURVEY.md §4): the pipelined schedules must
+reproduce the loss and gradients of the plain sequential model to fp32
+tolerance — the same check the reference's ``run_megatron_gpt_pipeline``
+tests do across real GPUs, here on the virtual mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import (
+    PipelineModel,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    microbatches,
+)
+
+VOCAB, SEQ, HIDDEN, FF = 64, 8, 16, 32
+
+
+def _embed_fn(p, mb):
+    x = p["word"][mb["ids"]]
+    return x + p["pos"][None, : x.shape[1]]
+
+
+def _stage_fn(p, x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    h = (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["ln_w"] + p["ln_b"]
+    h = jax.nn.gelu(h @ p["fc1"] + p["b1"]) @ p["fc2"] + p["b2"]
+    return x + h
+
+
+def _loss_fn(p, x, mb):
+    logits = x @ p["proj"] + p["bias"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, mb["labels"][..., None], -1)[..., 0]
+    return -ll.mean()
+
+
+MODEL = PipelineModel(_embed_fn, _stage_fn, _loss_fn)
+
+
+def _init(key, n_stages):
+    ks = jax.random.split(key, 4)
+    nrm = lambda k, s: jax.random.normal(k, s, jnp.float32) * 0.05  # noqa
+    embed = {"word": nrm(ks[0], (VOCAB, HIDDEN)),
+             "pos": nrm(ks[1], (SEQ, HIDDEN))}
+    sk = jax.random.split(ks[2], 2 * n_stages)
+    stages = {
+        "ln_w": jnp.ones((n_stages, HIDDEN)),
+        "ln_b": jnp.zeros((n_stages, HIDDEN)),
+        "fc1": jnp.stack([nrm(sk[2 * i], (HIDDEN, FF))
+                          for i in range(n_stages)]),
+        "b1": jnp.zeros((n_stages, FF)),
+        "fc2": jnp.stack([nrm(sk[2 * i + 1], (FF, HIDDEN))
+                          for i in range(n_stages)]),
+        "b2": jnp.zeros((n_stages, HIDDEN)),
+    }
+    head = {"proj": nrm(ks[3], (HIDDEN, VOCAB)), "bias": jnp.zeros((VOCAB,))}
+    return {"embed": embed, "stages": stages, "head": head}
+
+
+def _batch(key, batch_size):
+    k1, k2 = jax.random.split(key)
+    return {
+        "ids": jax.random.randint(k1, (batch_size, SEQ), 0, VOCAB),
+        "labels": jax.random.randint(k2, (batch_size, SEQ), 0, VOCAB),
+    }
+
+
+def _reference(params, batch, num_microbatches):
+    """Plain sequential grad-accumulated loss — the golden model."""
+    return forward_backward_no_pipelining(
+        MODEL, params, batch, num_microbatches=num_microbatches,
+        checkpoint_stages=False)
+
+
+def _tree_close(a, b, atol):
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=atol, rtol=1e-4)
+
+
+@pytest.mark.parametrize("pp,n_mb", [(2, 4), (4, 8), (2, 2)])
+def test_1f1b_matches_no_pipelining(pp, n_mb):
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+    params = _init(jax.random.PRNGKey(0), pp)
+    batch = _batch(jax.random.PRNGKey(1), 2 * n_mb)
+    ref_loss, ref_grads = _reference(params, batch, n_mb)
+
+    pipelined = ps.shard_map(
+        lambda p, b: forward_backward_pipelining_without_interleaving(
+            MODEL, p, b, num_microbatches=n_mb),
+        in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS), "head": P()},
+                  P()),
+        out_specs=(P(), {"embed": P(), "stages": P(ps.PIPE_AXIS),
+                         "head": P()}),
+    )
+    loss, grads = jax.jit(pipelined)(params, batch)
+    np.testing.assert_allclose(loss, ref_loss, atol=1e-5, rtol=1e-5)
+    _tree_close(grads, ref_grads, atol=1e-5)
+
+
+@pytest.mark.parametrize("pp,vpp,n_mb", [(2, 2, 4), (2, 3, 4), (4, 2, 4)])
+def test_interleaved_matches_no_pipelining(pp, vpp, n_mb):
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=pp,
+        virtual_pipeline_model_parallel_size_=vpp,
+        devices=jax.devices()[:pp])
+    n_stages = pp * vpp
+    params = _init(jax.random.PRNGKey(2), n_stages)
+    batch = _batch(jax.random.PRNGKey(3), 2 * n_mb)
+    ref_loss, ref_grads = _reference(params, batch, n_mb)
+
+    # chunk c -> slot [c // pp, c % pp]: a row-major reshape
+    iparams = dict(params)
+    iparams["stages"] = jax.tree.map(
+        lambda a: a.reshape((vpp, pp) + a.shape[1:]), params["stages"])
+
+    pipelined = ps.shard_map(
+        lambda p, b: forward_backward_pipelining_with_interleaving(
+            MODEL, p, b, num_microbatches=n_mb),
+        in_specs=({"embed": P(), "stages": P(None, ps.PIPE_AXIS),
+                   "head": P()}, P()),
+        out_specs=(P(), {"embed": P(), "stages": P(None, ps.PIPE_AXIS),
+                         "head": P()}),
+    )
+    loss, grads = jax.jit(pipelined)(iparams, batch)
+    np.testing.assert_allclose(loss, ref_loss, atol=1e-5, rtol=1e-5)
+    grads = dict(grads)
+    grads["stages"] = jax.tree.map(
+        lambda a: a.reshape((vpp * pp,) + a.shape[2:]), grads["stages"])
+    _tree_close(grads, ref_grads, atol=1e-5)
+
+
+def test_forward_only():
+    pp, n_mb = 2, 4
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+    params = _init(jax.random.PRNGKey(4), pp)
+    batch = _batch(jax.random.PRNGKey(5), 2 * n_mb)
+    ref_loss, _ = _reference(params, batch, n_mb)
+
+    fwd = ps.shard_map(
+        lambda p, b: forward_backward_pipelining_without_interleaving(
+            MODEL, p, b, num_microbatches=n_mb, forward_only=True)[0],
+        in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS), "head": P()},
+                  P()),
+        out_specs=P(),
+    )
+    loss = jax.jit(fwd)(params, batch)
+    np.testing.assert_allclose(loss, ref_loss, atol=1e-5, rtol=1e-5)
+
+
+def test_microbatch_count_from_calculator():
+    """num_microbatches defaults to the global calculator (ref:
+    ``get_num_microbatches``)."""
+    pp = 2
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+    microbatches.setup_microbatch_calculator(
+        rank=0, rampup_batch_size=None, global_batch_size=8,
+        micro_batch_size=2, data_parallel_size=1)
+    try:
+        assert microbatches.get_num_microbatches() == 4
+        params = _init(jax.random.PRNGKey(6), pp)
+        batch = _batch(jax.random.PRNGKey(7), 8)
+        ref_loss, _ = _reference(params, batch, 4)
+        pipelined = ps.shard_map(
+            lambda p, b: forward_backward_pipelining_without_interleaving(
+                MODEL, p, b)[0],
+            in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS),
+                       "head": P()}, P()),
+            out_specs=P(),
+        )
+        loss = jax.jit(pipelined)(params, batch)
+        np.testing.assert_allclose(loss, ref_loss, atol=1e-5, rtol=1e-5)
+    finally:
+        microbatches.destroy_num_microbatches_calculator()
+
+
+def test_dispatcher():
+    ps.initialize_model_parallel(devices=jax.devices()[:1])
+    assert get_forward_backward_func() is forward_backward_no_pipelining
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=2,
+                                 devices=jax.devices()[:2])
+    assert (get_forward_backward_func()
+            is forward_backward_pipelining_without_interleaving)
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=2,
+        virtual_pipeline_model_parallel_size_=2,
+        devices=jax.devices()[:2])
+    assert (get_forward_backward_func()
+            is forward_backward_pipelining_with_interleaving)
+
+
+def test_no_pipelining_forward_only_matches_grad_path():
+    ps.initialize_model_parallel(devices=jax.devices()[:1])
+    params = _init(jax.random.PRNGKey(8), 3)
+    batch = _batch(jax.random.PRNGKey(9), 4)
+    l1, g = forward_backward_no_pipelining(MODEL, params, batch,
+                                           num_microbatches=2)
+    l2, none = forward_backward_no_pipelining(
+        MODEL, params, batch, num_microbatches=2, forward_only=True)
+    assert none is None
+    np.testing.assert_allclose(l1, l2, atol=1e-6)
+    assert g is not None and jax.tree.leaves(g)
