@@ -1,5 +1,8 @@
 """Fused functional ops (ref: ``apex/transformer/functional``)."""
 
+from apex_tpu.transformer.functional.flash_attention import (  # noqa: F401
+    flash_attention,
+)
 from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
     FusedScaleMaskSoftmax,
     scaled_masked_softmax,

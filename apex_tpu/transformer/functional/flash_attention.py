@@ -1,0 +1,401 @@
+"""Fused multi-head attention — flash-attention Pallas kernels.
+
+Reference: ``apex/contrib/csrc/multihead_attn/*`` (fused QKV-softmax-
+dropout-PV fwd/bwd, ~8k CUDA LoC) and ``apex/contrib/csrc/fmha/*``
+(short-seqlen fused MHA) — SURVEY.md §2b calls this the largest single
+kernel work item. Both are subsumed by one seqlen-generic flash-style
+kernel pair:
+
+- **forward**: grid ``(batch*heads, q_tiles, k_tiles)``; per q-tile a
+  running (max, sum, acc) in VMEM scratch implements the online softmax
+  (FlashAttention-2 recurrence); scores never touch HBM. Saves the
+  per-row logsumexp for the backward.
+- **backward**: the standard two-pass split — a dq kernel (k innermost)
+  and a dk/dv kernel (q innermost) — recomputing score tiles from
+  (q, k, lse) instead of materializing the (s, s) probability matrix,
+  with ``D = rowsum(dout * out)`` precomputed outside.
+- **dropout** follows the reference's saved-mask semantics
+  (``masked_softmax_dropout_func``): probabilities are dropped AFTER
+  normalization. The keep mask is never stored — it is regenerated in
+  the backward from a counter-based hash of (seed, head, q, k), the
+  TPU-friendly analogue of the CUDA kernels' saved-RNG-state replay.
+
+Numerics: softmax in fp32 (scores masked to -1e30, matching the
+``-10000``-additive convention of the fused softmax kernels for any
+realistically-scaled logits); fully-masked rows return 0 (the
+flash/fmha convention). ``mask`` is (b, s_k) with 1 = attend.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.math import round_up_to_multiple
+from apex_tpu.utils.pallas import NEG_INF as _NEG, pad_axis as _pad_axis
+from apex_tpu.utils.platform import pallas_interpret
+
+def _block(s_padded: int) -> int:
+    """Largest of 512/256/128 that divides the padded length — bigger
+    blocks amortize grid overhead and feed the MXU larger matmuls."""
+    for cand in (512, 256, 128):
+        if s_padded % cand == 0:
+            return cand
+    return 128
+
+
+def _keep_mask(seed, head, q0, k0, shape, rate):
+    """Deterministic dropout keep-mask for a (TQ, TK) tile.
+
+    splitmix32-style integer mix over the GLOBAL (head, q, k) position so
+    forward and backward regenerate bit-identical masks from one uint32
+    seed — no (s, s) mask tensor is ever materialized.
+    """
+    tq, tk = shape
+    qpos = (q0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)).astype(
+        jnp.uint32)
+    kpos = (k0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)).astype(
+        jnp.uint32)
+    x = (qpos * jnp.uint32(0x9E3779B9)) ^ (kpos * jnp.uint32(0x85EBCA6B))
+    x = x ^ (seed + head.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= thresh  # keeps ~(1-rate) of positions
+
+
+def _score_mask(s, qt, kt, mask_row, sk, causal):
+    tq, tk = s.shape
+    kpos = kt * tk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = kpos < sk
+    if mask_row is not None:
+        valid &= (mask_row[None, :] != 0)
+    if causal:
+        qpos = qt * tq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        valid &= kpos <= qpos
+    return valid
+
+
+# -- forward ----------------------------------------------------------------
+
+def _fwd_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref,
+                o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sk, causal, rate):
+    i, qt, kt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kt == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * sc_ref[0, 0]
+    valid = _score_mask(s, qt, kt, mask_ref[0, 0, :], sk, causal)
+    s = jnp.where(valid, s, _NEG)
+
+    m_prev = m_ref[:, 0:1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.where(valid, jnp.exp(s - m_cur), 0.0)
+    l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + jnp.sum(p, -1, keepdims=True)
+    m_ref[:, 0:1] = m_cur
+    if rate > 0.0:
+        keep = _keep_mask(seed_ref[0, 0], i,
+                          qt * q.shape[0], kt * k.shape[0],
+                          p.shape, rate)
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kt == nk - 1)
+    def _():
+        l = l_ref[:, 0:1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = jnp.where(l > 0, acc_ref[:] / safe, 0.0).astype(
+            o_ref.dtype)
+        # lse row lives at column offset qt*TILE of the (1, 1, sq_p)
+        # full-row block (TPU block rules: last two dims must divide
+        # (8, 128) or equal the array dims — the singleton axis does)
+        lse_ref[0, 0, pl.ds(qt * q.shape[0], q.shape[0])] = jnp.where(
+            l[:, 0] > 0, m_ref[:, 0] + jnp.log(l[:, 0]), jnp.inf)
+
+
+# -- backward: dq -----------------------------------------------------------
+
+def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+               lse_ref, delta_ref, dq_ref, dq_acc, *, sk, causal, rate):
+    i, qt, kt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kt == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    scale = sc_ref[0, 0]
+    lse_row = lse_ref[0, 0, pl.ds(qt * q.shape[0], q.shape[0])]
+    delta_row = delta_ref[0, 0, pl.ds(qt * q.shape[0], q.shape[0])]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = _score_mask(s, qt, kt, mask_ref[0, 0, :], sk, causal)
+    p = jnp.where(valid, jnp.exp(s - lse_row[:, None]), 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        keep = _keep_mask(seed_ref[0, 0], i,
+                          qt * q.shape[0], kt * k.shape[0],
+                          p.shape, rate)
+        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+    ds = p * (dp - delta_row[:, None]) * scale
+    dq_acc[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kt == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# -- backward: dk, dv -------------------------------------------------------
+
+def _dkv_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+                lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                *, sk, causal, rate):
+    i, kt, qt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qt == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    scale = sc_ref[0, 0]
+    lse_row = lse_ref[0, 0, pl.ds(qt * q.shape[0], q.shape[0])]
+    delta_row = delta_ref[0, 0, pl.ds(qt * q.shape[0], q.shape[0])]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    valid = _score_mask(s, qt, kt, mask_ref[0, 0, :], sk, causal)
+    p = jnp.where(valid, jnp.exp(s - lse_row[:, None]), 0.0)
+    if rate > 0.0:
+        keep = _keep_mask(seed_ref[0, 0], i,
+                          qt * q.shape[0], kt * k.shape[0],
+                          p.shape, rate)
+        p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+    else:
+        p_drop = p
+    # dv += p_drop^T @ do
+    dv_acc[:] += jax.lax.dot_general(
+        p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+    ds = p * (dp - delta_row[:, None]) * scale
+    # dk += ds^T @ q
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qt == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# -- padding / call plumbing ------------------------------------------------
+
+def _smem():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _qkv_spec(tile, d):
+    return pl.BlockSpec((1, tile, d), lambda i, q, k: (i, q, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _prep(q, k, v, mask, b, h):
+    """Flatten (b,h,s,d) -> (b*h,s,d), pad s to tile multiples.
+
+    head_dim is padded only to a sublane multiple (8), NOT to 128: a
+    block whose last dim equals the array dim is legal, and padding
+    d=64 to 128 would double the QK/PV matmul FLOPs for nothing.
+    """
+    _, _, sq, d = q.shape
+    sk = k.shape[2]
+    sq_p = round_up_to_multiple(sq, 128)
+    sk_p = round_up_to_multiple(sk, 128)
+    d_p = round_up_to_multiple(d, 8)
+
+    def flat(x, s_p):
+        x = x.reshape(b * h, x.shape[2], d)
+        return _pad_axis(_pad_axis(x, s_p, 1), d_p, 2)
+
+    q3, k3, v3 = flat(q, sq_p), flat(k, sk_p), flat(v, sk_p)
+    if mask is None:
+        m3 = jnp.ones((b, 1, sk_p), jnp.int32)
+    else:
+        m3 = _pad_axis(mask.astype(jnp.int32).reshape(b, 1, sk), sk_p, 2)
+    return q3, k3, v3, m3, sq_p, sk_p, d_p
+
+
+def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    q3, k3, v3, m3, sq_p, sk_p, d_p = _prep(q, k, v, mask, b, h)
+    bq, bk = _block(sq_p), _block(sk_p)
+    grid = (b * h, sq_p // bq, sk_p // bk)
+    sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+    kv_spec = pl.BlockSpec((1, bk, d_p), lambda i, qt, kt: (i, kt, 0),
+                           memory_space=pltpu.VMEM)
+    mask_spec = pl.BlockSpec((1, 1, bk), lambda i, qt, kt: (i // h, 0, kt),
+                             memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, sq_p), lambda i, qt, kt: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sk=sk, causal=causal, rate=rate),
+        grid=grid,
+        in_specs=[_smem(), _smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
+                  mask_spec],
+        out_specs=(_qkv_spec(bq, d_p), row_spec),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, 1, sq_p), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        interpret=pallas_interpret(interpret),
+    )(sc, sd, q3, k3, v3, m3)
+    out = o[:, :sq, :d].reshape(b, h, sq, d)
+    return out, lse  # lse stays padded (b*h, 1, sq_p)
+
+
+def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
+              interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    q3, k3, v3, m3, sq_p, sk_p, d_p = _prep(q, k, v, mask, b, h)
+    do3 = _pad_axis(_pad_axis(do.reshape(b * h, sq, d), sq_p, 1), d_p, 2)
+    o3 = _pad_axis(_pad_axis(out.reshape(b * h, sq, d), sq_p, 1), d_p, 2)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    -1)[:, None, :]  # (bh, 1, sq_p) like lse
+    sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+
+    bq, bk = _block(sq_p), _block(sk_p)
+    row_spec = pl.BlockSpec((1, 1, sq_p), lambda i, qt, kt: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d_p), lambda i, qt, kt: (i, kt, 0),
+                           memory_space=pltpu.VMEM)
+    mask_spec = pl.BlockSpec((1, 1, bk), lambda i, qt, kt: (i // h, 0, kt),
+                             memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sk=sk, causal=causal, rate=rate),
+        grid=(b * h, sq_p // bq, sk_p // bk),
+        in_specs=[_smem(), _smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
+                  mask_spec, _qkv_spec(bq, d_p), row_spec, row_spec],
+        out_specs=_qkv_spec(bq, d_p),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
+        interpret=pallas_interpret(interpret),
+    )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
+
+    # dkv: k outer / q inner — index maps swap roles
+    q_spec2 = pl.BlockSpec((1, bq, d_p), lambda i, kt, qt: (i, qt, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, bk, d_p), lambda i, kt, qt: (i, kt, 0),
+                            memory_space=pltpu.VMEM)
+    mask_spec2 = pl.BlockSpec((1, 1, bk),
+                              lambda i, kt, qt: (i // h, 0, kt),
+                              memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, 1, sq_p), lambda i, kt, qt: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sk=sk, causal=causal, rate=rate),
+        grid=(b * h, sk_p // bk, sq_p // bq),
+        in_specs=[_smem(), _smem(), q_spec2, kv_spec2, kv_spec2, mask_spec2,
+                  q_spec2, row_spec2, row_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((bk, d_p), jnp.float32),
+                        pltpu.VMEM((bk, d_p), jnp.float32)],
+        interpret=pallas_interpret(interpret),
+    )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
+
+    dq = dq[:, :sq, :d].reshape(b, h, sq, d)
+    dk = dk[:, :sk, :d].reshape(b, h, sk, d)
+    dv = dv[:, :sk, :d].reshape(b, h, sk, d)
+    return dq, dk, dv
+
+
+# -- custom_vjp + public API ------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_core(cfg, q, k, v, mask, seed):
+    causal, scale, rate, interpret = cfg
+    out, _ = _fwd_call(q, k, v, mask, causal=causal, scale=scale, rate=rate,
+                       seed=seed, interpret=interpret)
+    return out
+
+
+def _flash_fwd(cfg, q, k, v, mask, seed):
+    causal, scale, rate, interpret = cfg
+    out, lse_p = _fwd_call(q, k, v, mask, causal=causal, scale=scale,
+                           rate=rate, seed=seed, interpret=interpret)
+    return out, (q, k, v, mask, out, lse_p, seed)
+
+
+def _flash_bwd(cfg, res, do):
+    causal, scale, rate, interpret = cfg
+    q, k, v, mask, out, lse_p, seed = res
+    dq, dk, dv = _bwd_call(q, k, v, mask, out, lse_p, do, causal=causal,
+                           scale=scale, rate=rate, seed=seed,
+                           interpret=interpret)
+    return dq, dk, dv, None, None
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None, *,
+                    causal: bool = False,
+                    softmax_scale: Optional[float] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_rng: Optional[jax.Array] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused scaled-dot-product attention.
+
+    Args:
+      q, k, v: (batch, heads, seq, head_dim).
+      mask: optional (batch, s_k) with 1 = attend (BERT convention).
+      causal: apply the implicit upper-triangular mask.
+      softmax_scale: defaults to 1/sqrt(head_dim).
+      dropout_rate: attention-probability dropout (after normalization,
+        reference semantics); active only when ``dropout_rng`` is given.
+      dropout_rng: PRNG key; folded to the kernel's uint32 seed.
+
+    Returns (batch, heads, seq, head_dim) in q's dtype.
+    """
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+    rate = float(dropout_rate) if dropout_rng is not None else 0.0
+    if rate > 0.0:
+        seed = jax.random.bits(dropout_rng, (), jnp.uint32)
+    else:
+        seed = jnp.zeros((), jnp.uint32)
+    cfg = (bool(causal), float(softmax_scale), rate, interpret)
+    return _flash_core(cfg, q, k, v, mask, seed)

@@ -14,7 +14,7 @@ SPMD model the whole schedule is instead ONE jitted program:
   ``pipe`` mesh axis** (each device holds its stage's slice);
 - the microbatch loop is a ``lax.scan`` over "ticks"; at every tick each
   device applies its stage and the activations rotate one stage forward
-  via ``lax.ppermute`` (see ``p2p_communication._shift``);
+  via ``lax.ppermute`` (``p2p_communication.send_forward_recv_forward``);
 - the backward pipeline is NOT hand-written: the schedule's forward is
   differentiated with ``jax.value_and_grad``, and the transpose of a
   ppermute-rotation scan *is* the reversed rotation scan — XLA's
@@ -66,7 +66,9 @@ from jax import lax
 
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel import microbatches as mb_calc
-from apex_tpu.transformer.pipeline_parallel.p2p_communication import _shift
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_forward_recv_forward,
+)
 
 Pytree = Any
 
@@ -191,7 +193,7 @@ def forward_backward_pipelining_without_interleaving(
             old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
             outs = lax.dynamic_update_index_in_dim(
                 outs, jnp.where(valid, y, old), slot, 0)
-            return (_shift(y, +1), outs), None
+            return (send_forward_recv_forward(y), outs), None
 
         state0 = jnp.zeros_like(xs[0])
         outs0 = jnp.zeros((M,) + state0.shape, state0.dtype)
@@ -277,7 +279,7 @@ def forward_backward_pipelining_with_interleaving(
             old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
             outs = lax.dynamic_update_index_in_dim(
                 outs, jnp.where(valid, ys[vpp - 1], old), slot, 0)
-            recv = _shift(ys, +1)
+            recv = send_forward_recv_forward(ys)
             # wraparound chunk boundary: stage 0's lane l continues the
             # work the last stage finished on lane l-1
             lanes = jnp.where(d == 0, jnp.roll(recv, 1, axis=0), recv)

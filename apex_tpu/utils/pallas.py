@@ -1,0 +1,27 @@
+"""Shared Pallas-kernel plumbing (padding, masking constants).
+
+One home for the helpers every kernel module needs, so fixes to
+padding/masking behavior apply everywhere at once.
+"""
+
+import jax.numpy as jnp
+
+# Masked-score constant. Finite (not -inf) so running-max arithmetic
+# (m_prev - m_cur etc.) never produces inf-inf NaNs; exp(-1e30 - m)
+# underflows to exactly 0 for any realistically-scaled logits, matching
+# the reference kernels' additive -10000 for fp16-scale inputs.
+NEG_INF = -1e30
+
+
+def pad_axis(x, size: int, axis: int, value=0.0):
+    """Zero-pad (or ``value``-pad) ``axis`` of ``x`` up to ``size``."""
+    if x.shape[axis] == size:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, size - x.shape[axis])
+    return jnp.pad(x, pads, constant_values=value)
+
+
+def pad2(x, rows: int, cols: int, value=0.0):
+    """Pad a 2-D array to (rows, cols)."""
+    return pad_axis(pad_axis(x, rows, 0, value), cols, 1, value)
