@@ -1,0 +1,13 @@
+"""Optional accelerants (ref: ``apex/contrib``).
+
+The reference gates each contrib package behind a build flag
+(``setup.py --xentropy --fast_multihead_attn ...``); here everything is
+importable — kernels compile on TPU and interpret on CPU.
+
+- :mod:`xentropy` — fused softmax-cross-entropy (no materialized softmax)
+- ``multihead_attn`` lives as the flash-attention kernel in
+  ``apex_tpu.transformer.functional.flash_attention`` (SURVEY §2b: the
+  fmha/fast_multihead_attn rows are subsumed by it).
+"""
+
+from apex_tpu.contrib import xentropy  # noqa: F401

@@ -38,6 +38,9 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     hidden_dropout: float = 0.1     # applied only when rng given
     attention_dropout: float = 0.1
+    # fused flash-attention path (ref: apex/contrib multihead_attn/fmha);
+    # False falls back to materialized scores + fused softmax kernel
+    fused_attention: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -108,12 +111,19 @@ def _ln(p, x, eps):
 
 
 def _attention(p, cfg: BertConfig, x, mask, dropout_rng=None):
-    from apex_tpu.transformer.functional import scaled_masked_softmax
+    from apex_tpu.transformer.functional import (
+        flash_attention, scaled_masked_softmax)
 
     b, s, h = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
     qkv = L.dense(p["qkv"], x).reshape(b, s, 3, nh, hd)
     q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+    if cfg.fused_attention:
+        ctx = flash_attention(
+            q, k, v, mask, softmax_scale=1.0 / math.sqrt(hd),
+            dropout_rate=cfg.attention_dropout, dropout_rng=dropout_rng)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+        return L.dense(p["out"], ctx)
     scores = jnp.einsum("bnqd,bnkd->bnqk", q, k)
     if mask is not None:
         # mask: (b, s) with 1 = attend; the fused kernel masks nonzero
@@ -179,12 +189,18 @@ def apply_bert(params: Dict[str, Any], cfg: BertConfig,
 
 def mlm_loss(logits: jax.Array, labels: jax.Array,
              label_mask: jax.Array) -> jax.Array:
-    """Masked-LM cross entropy in fp32; labels -100 convention NOT used —
-    ``label_mask`` (1 = predict) selects positions."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    """Masked-LM cross entropy in fp32; ``label_mask`` (1 = predict)
+    selects positions. Routed through the fused xentropy kernel (ref:
+    ``apex/contrib/xentropy``) so the (b, s, vocab) log-softmax is never
+    materialized."""
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+    b, s, v = logits.shape
+    flat_labels = jnp.where(label_mask != 0, labels, -1).reshape(b * s)
+    losses = softmax_cross_entropy_loss(logits.reshape(b * s, v),
+                                        flat_labels)
     m = label_mask.astype(jnp.float32)
-    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return losses.sum() / jnp.maximum(m.sum(), 1.0)
 
 
 def bert_partition_specs(params: Dict[str, Any]):
