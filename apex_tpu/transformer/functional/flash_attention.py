@@ -47,27 +47,33 @@ def _block(s_padded: int) -> int:
     return 128
 
 
-def _keep_mask(seed, head, q0, k0, shape, rate):
-    """Deterministic dropout keep-mask for a (TQ, TK) tile.
-
-    splitmix32-style integer mix over the GLOBAL (head, q, k) position so
-    forward and backward regenerate bit-identical masks from one uint32
-    seed — no (s, s) mask tensor is ever materialized.
-    """
-    tq, tk = shape
-    qpos = (q0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)).astype(
-        jnp.uint32)
-    kpos = (k0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)).astype(
-        jnp.uint32)
+def _hash_keep(qpos, kpos, head, seed_lo, seed_hi, rate):
+    """splitmix32-style integer mix over the GLOBAL (head, q, k) position so
+    forward and backward regenerate bit-identical masks from the seed — no
+    (s, s) mask tensor is ever materialized. 64 bits of PRNG-key entropy
+    are folded in as two uint32 words (seed_lo, seed_hi) so per-call seeds
+    do not birthday-collide at ~2^16 calls the way a single uint32 did.
+    Pure jnp — usable both inside the Pallas kernels and on the unfused
+    dispatch path (identical masks either way)."""
     x = (qpos * jnp.uint32(0x9E3779B9)) ^ (kpos * jnp.uint32(0x85EBCA6B))
-    x = x ^ (seed + head.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    x = x ^ (seed_lo + head.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
     x = x ^ (x >> 16)
     x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
+    x = x ^ (seed_hi + (x >> 15))
     x = x * jnp.uint32(0x846CA68B)
     x = x ^ (x >> 16)
     thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
     return x >= thresh  # keeps ~(1-rate) of positions
+
+
+def _keep_mask(seed_ref, head, q0, k0, shape, rate):
+    """Deterministic dropout keep-mask for a (TQ, TK) tile (kernel view)."""
+    qpos = (q0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)).astype(
+        jnp.uint32)
+    kpos = (k0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)).astype(
+        jnp.uint32)
+    return _hash_keep(qpos, kpos, head, seed_ref[0, 0], seed_ref[0, 1],
+                      rate)
 
 
 def _score_mask(s, qt, kt, mask_row, sk, causal):
@@ -110,7 +116,7 @@ def _fwd_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref,
     l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + jnp.sum(p, -1, keepdims=True)
     m_ref[:, 0:1] = m_cur
     if rate > 0.0:
-        keep = _keep_mask(seed_ref[0, 0], i,
+        keep = _keep_mask(seed_ref, i,
                           qt * q.shape[0], kt * k.shape[0],
                           p.shape, rate)
         p = jnp.where(keep, p / (1.0 - rate), 0.0)
@@ -153,7 +159,7 @@ def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     if rate > 0.0:
-        keep = _keep_mask(seed_ref[0, 0], i,
+        keep = _keep_mask(seed_ref, i,
                           qt * q.shape[0], kt * k.shape[0],
                           p.shape, rate)
         dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
@@ -189,7 +195,7 @@ def _dkv_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
     valid = _score_mask(s, qt, kt, mask_ref[0, 0, :], sk, causal)
     p = jnp.where(valid, jnp.exp(s - lse_row[:, None]), 0.0)
     if rate > 0.0:
-        keep = _keep_mask(seed_ref[0, 0], i,
+        keep = _keep_mask(seed_ref, i,
                           qt * q.shape[0], kt * k.shape[0],
                           p.shape, rate)
         p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
@@ -258,7 +264,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
     bq, bk = _block(sq_p), _block(sk_p)
     grid = (b * h, sq_p // bq, sk_p // bk)
     sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
-    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
     kv_spec = pl.BlockSpec((1, bk, d_p), lambda i, qt, kt: (i, kt, 0),
                            memory_space=pltpu.VMEM)
     mask_spec = pl.BlockSpec((1, 1, bk), lambda i, qt, kt: (i // h, 0, kt),
@@ -292,7 +298,7 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     -1)[:, None, :]  # (bh, 1, sq_p) like lse
     sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
-    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 1)
+    sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
 
     bq, bk = _block(sq_p), _block(sk_p)
     row_spec = pl.BlockSpec((1, 1, sq_p), lambda i, qt, kt: (i, 0, 0),
@@ -370,12 +376,56 @@ def _flash_bwd(cfg, res, do):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Measured crossover on TPU v5e (b=16, h=16, d=64, fwd+bwd): at padded
+# seq <= 256 XLA's single batched einsum+softmax beats the tiled kernel
+# (the kernel degenerates to b*h sequential one-tile programs), while at
+# >= 512 the kernel wins and at 2048 it is ~2x faster. Dispatch on size
+# so every caller gets the better path at its shape.
+_UNFUSED_MAX_SEQ = 256
+
+
+def _unfused_attention(q, k, v, mask, seed, *, causal, scale, rate):
+    """Mathematically-identical XLA path for short sequences.
+
+    Same masking convention (fully-masked rows return 0) and the SAME
+    ``_hash_keep`` dropout mask as the kernels, so dispatch never changes
+    training randomness semantics; autodiff replays the mask bit-exactly
+    in the backward because the hash is deterministic in its inputs.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is None:
+        valid = jnp.ones((1, 1, 1, sk), bool)
+    else:
+        valid = (mask[:, None, None, :] != 0)
+    if causal:
+        tri = (jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None])
+        valid = valid & tri[None, None]
+    s = jnp.where(valid, s, _NEG)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, -1, keepdims=True)
+    p = jnp.where(l > 0, p / jnp.where(l > 0, l, 1.0), 0.0)
+    if rate > 0.0:
+        # global (bh, q, k) positions — identical mask to the kernel's
+        bh = jnp.arange(b * h, dtype=jnp.uint32).reshape(b, h, 1, 1)
+        qpos = jnp.arange(sq, dtype=jnp.uint32).reshape(1, 1, sq, 1)
+        kpos = jnp.arange(sk, dtype=jnp.uint32).reshape(1, 1, 1, sk)
+        keep = _hash_keep(qpos, kpos, bh, seed[0], seed[1], rate)
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array] = None, *,
                     causal: bool = False,
                     softmax_scale: Optional[float] = None,
                     dropout_rate: float = 0.0,
                     dropout_rng: Optional[jax.Array] = None,
+                    use_kernel: Optional[bool] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused scaled-dot-product attention.
 
@@ -386,7 +436,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       softmax_scale: defaults to 1/sqrt(head_dim).
       dropout_rate: attention-probability dropout (after normalization,
         reference semantics); active only when ``dropout_rng`` is given.
-      dropout_rng: PRNG key; folded to the kernel's uint32 seed.
+      dropout_rng: PRNG key; 64 bits folded into the dropout-hash seed.
+      use_kernel: force the Pallas kernel (True) or the XLA path (False);
+        None auto-dispatches on sequence length (kernel when the padded
+        seq exceeds ``_UNFUSED_MAX_SEQ`` — the measured v5e crossover).
 
     Returns (batch, heads, seq, head_dim) in q's dtype.
     """
@@ -394,8 +447,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
     rate = float(dropout_rate) if dropout_rng is not None else 0.0
     if rate > 0.0:
-        seed = jax.random.bits(dropout_rng, (), jnp.uint32)
+        seed = jax.random.bits(dropout_rng, (2,), jnp.uint32)
     else:
-        seed = jnp.zeros((), jnp.uint32)
+        seed = jnp.zeros((2,), jnp.uint32)
+    if use_kernel is None:
+        use_kernel = max(q.shape[2], k.shape[2]) > _UNFUSED_MAX_SEQ
+    if not use_kernel:
+        return _unfused_attention(q, k, v, mask, seed, causal=bool(causal),
+                                  scale=float(softmax_scale), rate=rate)
     cfg = (bool(causal), float(softmax_scale), rate, interpret)
     return _flash_core(cfg, q, k, v, mask, seed)

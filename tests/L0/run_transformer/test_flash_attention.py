@@ -15,6 +15,13 @@ import pytest
 from apex_tpu.transformer.functional import flash_attention
 
 
+@pytest.fixture(params=[True, False], ids=["kernel", "xla"])
+def fa(request):
+    """Exercise BOTH dispatch paths: the Pallas kernel and the XLA
+    short-seq path (`use_kernel` forced each way)."""
+    return functools.partial(flash_attention, use_kernel=request.param)
+
+
 def _reference(q, k, v, mask=None, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -47,33 +54,33 @@ TOL = {jnp.float32: dict(atol=2e-5, rtol=2e-5),
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 @pytest.mark.parametrize("causal", [False, True])
-def test_forward_matches_reference(dtype, causal):
+def test_forward_matches_reference(dtype, causal, fa):
     q, k, v = _qkv(jax.random.PRNGKey(0), 2, 3, 80, 24, dtype)
-    out = flash_attention(q, k, v, causal=causal)
+    out = fa(q, k, v, causal=causal)
     ref = _reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32), **TOL[dtype])
 
 
-def test_forward_padding_mask():
+def test_forward_padding_mask(fa):
     q, k, v = _qkv(jax.random.PRNGKey(1), 2, 2, 40, 16, jnp.float32)
     mask = (jax.random.uniform(jax.random.PRNGKey(2), (2, 40)) > 0.3)
     mask = mask.at[:, 0].set(True).astype(jnp.int32)
-    out = flash_attention(q, k, v, mask)
+    out = fa(q, k, v, mask)
     ref = _reference(q, k, v, mask)
     np.testing.assert_allclose(out, ref, **TOL[jnp.float32])
 
 
-def test_fully_masked_rows_return_zero():
+def test_fully_masked_rows_return_zero(fa):
     q, k, v = _qkv(jax.random.PRNGKey(3), 1, 1, 8, 8, jnp.float32)
     mask = jnp.zeros((1, 8), jnp.int32)
-    out = flash_attention(q, k, v, mask)
+    out = fa(q, k, v, mask)
     np.testing.assert_allclose(out, jnp.zeros_like(out), atol=0)
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 @pytest.mark.parametrize("causal", [False, True])
-def test_grads_match_reference(dtype, causal):
+def test_grads_match_reference(dtype, causal, fa):
     q, k, v = _qkv(jax.random.PRNGKey(4), 2, 2, 48, 16, dtype)
     mask = None
     if not causal:
@@ -81,7 +88,7 @@ def test_grads_match_reference(dtype, causal):
         mask = mask.at[:, 0].set(True).astype(jnp.int32)
 
     def loss_flash(q, k, v):
-        return (flash_attention(q, k, v, mask, causal=causal)
+        return (fa(q, k, v, mask, causal=causal)
                 .astype(jnp.float32) ** 2).sum()
 
     def loss_ref(q, k, v):
@@ -97,19 +104,19 @@ def test_grads_match_reference(dtype, causal):
                                    np.asarray(b, np.float32), **tol)
 
 
-def test_cross_attention_seq_lengths():
+def test_cross_attention_seq_lengths(fa):
     """sq != sk (encoder-decoder shape, ref encdec_multihead_attn)."""
     key = jax.random.PRNGKey(6)
     ks = jax.random.split(key, 3)
     q = jax.random.normal(ks[0], (2, 2, 24, 16))
     k = jax.random.normal(ks[1], (2, 2, 56, 16))
     v = jax.random.normal(ks[2], (2, 2, 56, 16))
-    out = flash_attention(q, k, v)
+    out = fa(q, k, v)
     ref = _reference(q, k, v)
     np.testing.assert_allclose(out, ref, **TOL[jnp.float32])
 
 
-def test_dropout_statistics_and_determinism():
+def test_dropout_statistics_and_determinism(fa):
     q, k, v = _qkv(jax.random.PRNGKey(7), 1, 2, 64, 16, jnp.float32)
     rng = jax.random.PRNGKey(8)
     f = functools.partial(flash_attention, dropout_rate=0.5, dropout_rng=rng)
@@ -117,23 +124,23 @@ def test_dropout_statistics_and_determinism():
     # same rng => identical output (saved-mask semantics)
     np.testing.assert_array_equal(o1, o2)
     # different rng => different output
-    o3 = flash_attention(q, k, v, dropout_rate=0.5,
+    o3 = fa(q, k, v, dropout_rate=0.5,
                          dropout_rng=jax.random.PRNGKey(9))
     assert not np.allclose(o1, o3)
     # dropout is unbiased-ish: mean magnitude comparable to no-dropout
-    o0 = flash_attention(q, k, v)
+    o0 = fa(q, k, v)
     ratio = float(jnp.abs(o1).mean() / jnp.abs(o0).mean())
     assert 0.5 < ratio < 2.0, ratio
 
 
-def test_dropout_backward_uses_same_mask():
+def test_dropout_backward_uses_same_mask(fa):
     """grad must see the same keep mask as the forward: finite-difference
     check along a random direction."""
     q, k, v = _qkv(jax.random.PRNGKey(10), 1, 1, 32, 8, jnp.float32)
     rng = jax.random.PRNGKey(11)
 
     def loss(q):
-        return (flash_attention(q, k, v, dropout_rate=0.3, dropout_rng=rng)
+        return (fa(q, k, v, dropout_rate=0.3, dropout_rng=rng)
                 ** 2).sum()
 
     g = jax.grad(loss)(q)
@@ -144,8 +151,34 @@ def test_dropout_backward_uses_same_mask():
     np.testing.assert_allclose(fd, analytic, rtol=2e-2, atol=2e-2)
 
 
-def test_softmax_scale_override():
+def test_softmax_scale_override(fa):
     q, k, v = _qkv(jax.random.PRNGKey(13), 1, 2, 32, 16, jnp.float32)
-    out = flash_attention(q, k, v, softmax_scale=0.05)
+    out = fa(q, k, v, softmax_scale=0.05)
     ref = _reference(q, k, v, scale=0.05)
     np.testing.assert_allclose(out, ref, **TOL[jnp.float32])
+
+
+def test_dispatch_paths_agree_with_dropout():
+    """Kernel and XLA paths must produce the SAME dropped output for the
+    same rng (shared _hash_keep mask) — dispatch never changes training
+    randomness."""
+    q, k, v = _qkv(jax.random.PRNGKey(14), 1, 2, 64, 16, jnp.float32)
+    rng = jax.random.PRNGKey(15)
+    a = flash_attention(q, k, v, dropout_rate=0.4, dropout_rng=rng,
+                        use_kernel=True)
+    b = flash_attention(q, k, v, dropout_rate=0.4, dropout_rng=rng,
+                        use_kernel=False)
+    np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+def test_auto_dispatch_threshold():
+    """Below the crossover the XLA path runs (no pallas_call in the jaxpr);
+    above it the kernel runs."""
+    q, k, v = _qkv(jax.random.PRNGKey(16), 1, 1, 64, 8, jnp.float32)
+    jaxpr = str(jax.make_jaxpr(lambda q, k, v: flash_attention(q, k, v))(
+        q, k, v))
+    assert "pallas_call" not in jaxpr
+    q2, k2, v2 = _qkv(jax.random.PRNGKey(17), 1, 1, 512, 8, jnp.float32)
+    jaxpr2 = str(jax.make_jaxpr(lambda q, k, v: flash_attention(q, k, v))(
+        q2, k2, v2))
+    assert "pallas_call" in jaxpr2
