@@ -86,9 +86,13 @@ class MultiTensorApply:
         self.chunk_size = chunk_size
 
     def __call__(self, op, noop_flag, tensor_lists, *args):
-        del noop_flag
         if callable(op):
-            return op(*tensor_lists, *args)
+            # Reference arity: ``op(chunk_size, noop_flag, tensor_lists,
+            # *args)`` (apex passes both through to the CUDA kernel). We
+            # forward them unchanged so ops written against the apex
+            # convention drop in; pure-XLA ops are free to ignore them.
+            return op(self.chunk_size, noop_flag, tensor_lists, *args)
+        del noop_flag
         if op == "scale":
             (src, *rest) = tensor_lists
             out_dtypes = [t.dtype for t in rest[0]] if rest else None

@@ -32,6 +32,9 @@ class FusedAdagrad:
              lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, AdagradState]:
+        """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
+        scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
+        DIVIDES — invert when porting. See ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
         eps = f32(self.eps)

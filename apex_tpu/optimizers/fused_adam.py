@@ -72,6 +72,16 @@ class FusedAdam:
              lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, AdamState]:
+        """One optimizer step.
+
+        ``grad_scale`` MULTIPLIES the gradients (it is the combined
+        inverse loss scale: pass ``1 / loss_scale`` to unscale). Note the
+        reference's ``FusedAdam.step(scale=...)`` takes the factor to
+        DIVIDE by; callers porting from apex must invert. This convention
+        is uniform across every ``apex_tpu.optimizers`` step and the flat
+        Pallas kernel (``kernels.flat_adam``), chosen so the unscale
+        fuses into the update as a multiply without a reciprocal op.
+        """
         lr = f32(self.lr if lr is None else lr)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1

@@ -56,6 +56,9 @@ class FusedLAMB:
              grad_norm: Optional[jax.Array] = None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, LambState]:
+        """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
+        scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
+        DIVIDES — invert when porting. See ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         gs = f32(grad_scale)

@@ -43,6 +43,9 @@ class FusedSGD:
              lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None
              ) -> Tuple[Any, SGDState]:
+        """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
+        scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
+        DIVIDES — invert when porting. See ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
         mom, damp = f32(self.momentum), f32(self.dampening)

@@ -93,9 +93,17 @@ class DistributedDataParallel:
         rank = lax.axis_index(axis)
 
         def bcast(p):
-            masked = jnp.where(rank == 0, p.astype(jnp.float32),
-                               jnp.zeros_like(p, jnp.float32))
-            return lax.psum(masked, axis).astype(p.dtype)
+            # Masked psum: every rank but 0 contributes exact zeros, so
+            # the sum reproduces rank 0's value EXACTLY in the leaf's own
+            # dtype — no fp32 round-trip (which would truncate f64 and
+            # corrupt wide-int leaves). Bool/int leaves ride through int32
+            # (XLA collectives need an arithmetic type for bool).
+            if p.dtype == jnp.bool_:
+                masked = jnp.where(rank == 0, p.astype(jnp.int32),
+                                   jnp.zeros(p.shape, jnp.int32))
+                return lax.psum(masked, axis).astype(jnp.bool_)
+            masked = jnp.where(rank == 0, p, jnp.zeros_like(p))
+            return lax.psum(masked, axis)
 
         return jax.tree.map(bcast, params)
 

@@ -12,15 +12,39 @@ from apex_tpu.amp.scaler import LossScaler, LossScalerState  # noqa: F401
 from apex_tpu.transformer import parallel_state as ps
 
 
+def _axis_is_bound(name: str) -> bool:
+    """True iff ``name`` is a mapped axis in the current trace context.
+
+    Prefers the axis-env query (private module, hasattr-gated); falls back
+    to probing with a throwaway psum, whose unbound-axis failure is a
+    trace-time error — either way this resolves while tracing, so no
+    runtime branch is compiled.
+    """
+    try:
+        from jax._src import core as _core
+        env = _core.get_axis_env()
+        if hasattr(env, "axis_exists"):
+            return bool(env.axis_exists(name))
+    except Exception:
+        pass
+    try:
+        lax.psum(jnp.int32(0), name)
+        return True
+    except Exception:
+        return False
+
+
 class GradScaler(LossScaler):
     """``unscale`` additionally ORs found_inf over the TP (and pipe) axes —
     a rank that overflowed must make EVERY rank skip the step (the
     reference allreduces found_inf over the model-parallel group). Call
-    inside shard_map."""
+    inside shard_map. Axes not bound by the enclosing mapped region (a
+    tp-only or pp-only shard_map) are skipped rather than erroring."""
 
     def unscale(self, grads: Any, state: LossScalerState
                 ) -> Tuple[Any, jnp.ndarray]:
         grads, found_inf = super().unscale(grads, state)
         for axis in (ps.TENSOR_AXIS, ps.PIPE_AXIS):
-            found_inf = lax.pmax(found_inf.astype(jnp.int32), axis) > 0
+            if _axis_is_bound(axis):
+                found_inf = lax.pmax(found_inf.astype(jnp.int32), axis) > 0
         return grads, found_inf

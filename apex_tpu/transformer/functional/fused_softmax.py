@@ -218,7 +218,21 @@ class FusedScaleMaskSoftmax:
             raise RuntimeError("softmax should be in fp32 when scaled")
 
     def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
-        return bool(self.fusion)
+        """Mirrors the reference's gate with the gates that still apply.
+
+        Kept from the reference (``fused_softmax.py ::
+        is_kernel_available``): the user fusion flag and the
+        input-in-float16 requirement — the fused path is specified for
+        half-precision inputs (fp32 callers get the fp32-softmax fallback
+        with identical numerics, as upstream).  Dropped, with reason: the
+        CUDA tiling limits (16 < sk <= 16384, sq/sk % 4, attn_batches %
+        batch_per_block) exist because the CUDA kernels are compiled for
+        fixed tile geometries; the Pallas kernels pad to (8,128) lanes and
+        take seqlen as a grid parameter, so every shape is eligible.
+        Added: ``sq > 1`` — a single-query (decode) softmax is one VPU row
+        where kernel dispatch is pure overhead.
+        """
+        return bool(self.fusion) and self.input_in_float16 and sq > 1
 
     def __call__(self, x, mask=None):
         scale = self.scale if self.scale is not None else 1.0

@@ -80,9 +80,29 @@ def send_backward_recv_forward(input_tensor_grad: Any,
     return _shift(input_tensor_grad, -1), _shift(output_tensor, +1)
 
 
-# Lone send/recv: in SPMD the matching half always exists on the neighbor,
-# so these are the combined collective under the reference's name.
-send_forward = send_forward_recv_forward
-recv_forward = send_forward_recv_forward
-send_backward = send_backward_recv_backward
-recv_backward = send_backward_recv_backward
+# Lone send/recv: under SPMD a "send" and its matching "recv" are ONE
+# collective, so code ported from the reference that calls send_forward(x)
+# and then recv_forward(...) — two ops in the NCCL world — would ppermute
+# TWICE here and double-shift activations. Rather than silently alias,
+# the lone names fail fast with the correct replacement.
+
+def _one_collective(name: str, repl: str):
+    def guard(*_a, **_k):
+        raise RuntimeError(
+            f"p2p_communication.{name}: under SPMD the send and its "
+            f"matching recv are a single collective — call {repl}(x) "
+            f"EXACTLY ONCE per exchange (it both sends and returns the "
+            f"received value). Calling lone send_*/recv_* pairs as in "
+            f"the reference would ppermute twice and double-shift.")
+    guard.__name__ = name
+    guard.__doc__ = (f"Removed alias; use :func:`{repl}` once per "
+                     f"exchange (see module docstring).")
+    return guard
+
+
+send_forward = _one_collective("send_forward", "send_forward_recv_forward")
+recv_forward = _one_collective("recv_forward", "send_forward_recv_forward")
+send_backward = _one_collective("send_backward",
+                                "send_backward_recv_backward")
+recv_backward = _one_collective("recv_backward",
+                                "send_backward_recv_backward")

@@ -167,3 +167,21 @@ def test_flat_adam_kernel_matches_manual():
     got = unflatten_tensors(p1, spec)[0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_applier_callable_reference_arity():
+    # apex convention: applier(op, noop_flag, tensor_lists, *args) invokes
+    # op(chunk_size, noop_flag, tensor_lists, *args) — the first two must
+    # be forwarded, not dropped
+    applier = MultiTensorApply(4096)
+    seen = {}
+
+    def op(chunk_size, noop_flag, tensor_lists, alpha):
+        seen.update(chunk_size=chunk_size, noop_flag=noop_flag,
+                    n_lists=len(tensor_lists), alpha=alpha)
+        return [t * alpha for t in tensor_lists[0]]
+
+    out = applier(op, "noop", [[jnp.ones(3)]], 2.0)
+    assert seen == {"chunk_size": 4096, "noop_flag": "noop",
+                    "n_lists": 1, "alpha": 2.0}
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(3, 2.0))

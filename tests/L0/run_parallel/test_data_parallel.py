@@ -200,3 +200,27 @@ def test_ddp_bert_tiny_train_step():
         params, state, loss = step(params, state, ids, mask)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_broadcast_params_exact_for_int_leaves():
+    # masked-psum broadcast must not round-trip through fp32: an int32
+    # value above 2^24 would silently lose low bits there
+    mesh = dp_mesh()
+    ddp = DistributedDataParallel()
+    big = (1 << 24) + 1
+
+    def f(rank_seed):
+        tree = {
+            "w": jnp.float32(1.5) + rank_seed,     # differs per rank
+            "step": jnp.int32(big) + rank_seed.astype(jnp.int32),
+            "flag": rank_seed < 0,                  # bool leaf
+        }
+        return ddp.broadcast_params(tree)
+
+    seeds = jnp.arange(8, dtype=jnp.float32)
+    out = shard_map(f, mesh, in_specs=(P(ps.DATA_AXIS),),
+                    out_specs=P(ps.DATA_AXIS))(seeds)
+    # every rank must now hold rank 0's exact values
+    assert np.asarray(out["step"]).tolist() == [big] * 8
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full(8, 1.5))
+    assert np.asarray(out["flag"]).tolist() == [False] * 8

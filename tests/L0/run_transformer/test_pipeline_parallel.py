@@ -219,3 +219,16 @@ def test_no_pipelining_forward_only_matches_grad_path():
     assert none is None
     np.testing.assert_allclose(l1, l2, atol=1e-6)
     assert g is not None and jax.tree.leaves(g)
+
+
+def test_lone_send_recv_fail_fast():
+    # Under SPMD a send and its matching recv are ONE ppermute; the lone
+    # reference names must refuse to run rather than double-shift
+    import pytest
+
+    from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+    for fn in (p2p.send_forward, p2p.recv_forward,
+               p2p.send_backward, p2p.recv_backward):
+        with pytest.raises(RuntimeError, match="single collective"):
+            fn(jnp.ones(4))
