@@ -30,7 +30,10 @@ def _axis_is_bound(name: str) -> bool:
     try:
         lax.psum(jnp.int32(0), name)
         return True
-    except Exception:
+    except NameError:
+        # the unbound-axis trace error; anything else must propagate —
+        # failing open here would silently skip the cross-rank found_inf
+        # OR and let optimizer states diverge across TP ranks
         return False
 
 

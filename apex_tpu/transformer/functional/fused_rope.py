@@ -1,0 +1,141 @@
+"""Fused rotary positional embedding (RoPE).
+
+Reference: ``apex/transformer/functional/fused_rope.py`` backed by
+``csrc/megatron/fused_rotary_positional_embedding*`` — CUDA kernels whose
+entire job is fusing the ``t*cos + rotate_half(t)*sin`` elementwise chain
+into one pass and providing a hand-written backward.
+
+TPU design: RoPE is purely elementwise over (seq, dim) broadcast factors.
+XLA fuses elementwise chains into the surrounding matmuls natively, so a
+Pallas kernel would only re-derive what the fusion pass already does (this
+is the "let XLA fuse" rule, not a deferral). What the CUDA kernel's
+hand-written backward DOES buy — computing dt as the rotation by ``-θ``
+(the transpose of a rotation) instead of replaying the product rule, and
+never materializing ``rotate_half(t)`` as a saved residual — is captured
+here with a ``custom_vjp``. Unlike the reference kernel (whose backward
+returns no gradient for freqs at all), the vjp also emits the true
+cotangents for cos/sin so learned/scaled rotary tables train correctly
+rather than silently receiving zeros. Internal math is fp32 (the CUDA
+kernel computes in float internally too); the output is cast back once.
+
+Conventions (reference parity):
+- ``freqs`` is (s, 1, 1, d_rot) — position-outer-product-with-inv-freq,
+  NOT yet cos/sin (``_cached`` takes precomputed cos/sin).
+- tensors are sbhd (Megatron (seq, batch, head, dim)) unless the
+  ``_bshd``/``_bhsd`` wrappers are used.
+- when d_rot < d, the trailing ``d - d_rot`` channels pass through
+  untouched (reference behavior for partial rotary).
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(dim: int, seq_len: int, base: float = 10000.0,
+                     dtype=jnp.float32) -> jax.Array:
+    """The (s, 1, 1, dim) angle tensor θ_{p,i} = p · base^(-2i/dim).
+
+    Matches the reference testing helper (RotaryEmbedding in
+    ``apex/transformer/testing``): inv_freq over even channels, angles
+    duplicated across the two rotation halves.
+    """
+    inv_freq = 1.0 / (base ** (
+        jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    ang = jnp.outer(pos, inv_freq)                     # (s, dim/2)
+    ang = jnp.concatenate([ang, ang], axis=-1)          # (s, dim)
+    return ang.astype(dtype)[:, None, None, :]
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _apply(t: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """t*cos + rotate_half(t)*sin on the leading d_rot channels, fp32
+    internally, cast back to t's dtype once."""
+    d_rot = cos.shape[-1]
+    if d_rot < t.shape[-1]:
+        rot, rest = t[..., :d_rot], t[..., d_rot:]
+    else:
+        rot, rest = t, None
+    r32 = rot.astype(jnp.float32)
+    out = (r32 * cos.astype(jnp.float32)
+           + _rotate_half(r32) * sin.astype(jnp.float32)).astype(t.dtype)
+    if rest is not None:
+        out = jnp.concatenate([out, rest], axis=-1)
+    return out
+
+
+def _reduce_to(x: jax.Array, shape) -> jax.Array:
+    """Sum ``x`` over the axes the (same-rank) target ``shape`` broadcasts."""
+    axes = tuple(i for i, n in enumerate(shape) if n == 1 and x.shape[i] != 1)
+    return jnp.sum(x, axis=axes, keepdims=True) if axes else x
+
+
+@jax.custom_vjp
+def _rope_core(t: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    return _apply(t, cos, sin)
+
+
+def _rope_fwd(t, cos, sin):
+    return _apply(t, cos, sin), (t, cos, sin)
+
+
+def _rope_bwd(res, g):
+    # dt: R(θ)ᵀ = R(−θ) — the same elementwise form with sin negated (no
+    # product-rule replay, no saved rotate_half residual). dcos/dsin: the
+    # product-rule factors, reduced over the axes cos/sin broadcast.
+    t, cos, sin = res
+    d_rot = cos.shape[-1]
+    dt = _apply(g, cos, -sin)
+    g32 = g[..., :d_rot].astype(jnp.float32)
+    r32 = t[..., :d_rot].astype(jnp.float32)
+    dcos = _reduce_to(g32 * r32, cos.shape).astype(cos.dtype)
+    dsin = _reduce_to(g32 * _rotate_half(r32), sin.shape).astype(sin.dtype)
+    return dt, dcos, dsin
+
+
+_rope_core.defvjp(_rope_fwd, _rope_bwd)
+
+
+def fused_apply_rotary_pos_emb(t: jax.Array, freqs: jax.Array) -> jax.Array:
+    """Reference ``fused_apply_rotary_pos_emb``: t (s, b, h, d),
+    freqs (s, 1, 1, d_rot) angles; returns t's dtype/shape."""
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    return _rope_core(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_cached(t: jax.Array, cos: jax.Array,
+                                      sin: jax.Array) -> jax.Array:
+    """Reference ``fused_apply_rotary_pos_emb_cached``: precomputed
+    cos/sin (s, 1, 1, d_rot) — saves the transcendentals when the tables
+    are reused across layers (GPT does this)."""
+    return _rope_core(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_bshd(t: jax.Array,
+                                    freqs: jax.Array) -> jax.Array:
+    """(b, s, h, d) layout wrapper."""
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    return _rope_core(t, cos[None, :, 0], sin[None, :, 0])
+
+
+def fused_apply_rotary_pos_emb_bhsd(t: jax.Array,
+                                    freqs: jax.Array) -> jax.Array:
+    """(b, h, s, d) layout wrapper — the in-tree models' attention layout."""
+    cos = jnp.cos(freqs).reshape(freqs.shape[0], freqs.shape[-1])
+    sin = jnp.sin(freqs).reshape(freqs.shape[0], freqs.shape[-1])
+    return _rope_core(t, cos[None, None], sin[None, None])
+
+
+def rope_cos_sin(dim: int, seq_len: int, base: float = 10000.0,
+                 dtype=jnp.float32
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Precomputed (cos, sin) tables for the ``_cached`` entry point."""
+    freqs = rope_frequencies(dim, seq_len, base, jnp.float32)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)

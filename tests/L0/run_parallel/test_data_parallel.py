@@ -213,7 +213,7 @@ def test_broadcast_params_exact_for_int_leaves():
         tree = {
             "w": jnp.float32(1.5) + rank_seed,     # differs per rank
             "step": jnp.int32(big) + rank_seed.astype(jnp.int32),
-            "flag": rank_seed < 0,                  # bool leaf
+            "flag": rank_seed < 1,                  # bool: True ONLY on rank 0
         }
         return ddp.broadcast_params(tree)
 
@@ -223,4 +223,4 @@ def test_broadcast_params_exact_for_int_leaves():
     # every rank must now hold rank 0's exact values
     assert np.asarray(out["step"]).tolist() == [big] * 8
     np.testing.assert_array_equal(np.asarray(out["w"]), np.full(8, 1.5))
-    assert np.asarray(out["flag"]).tolist() == [False] * 8
+    assert np.asarray(out["flag"]).tolist() == [True] * 8
