@@ -16,6 +16,18 @@ from apex_tpu.models.bert import (  # noqa: F401
     init_bert,
     mlm_loss,
 )
+from apex_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    apply_gpt_unsharded,
+    gpt_loss_unsharded,
+    gpt_medium,
+    gpt_partition_specs,
+    gpt_pipeline_model,
+    gpt_tiny,
+    gpt_to_pipeline_params,
+    init_gpt,
+)
 from apex_tpu.models.resnet import (  # noqa: F401
     apply_resnet,
     cross_entropy_loss,

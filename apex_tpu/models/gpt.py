@@ -1,0 +1,501 @@
+"""Tensor-parallel GPT (decoder-only transformer).
+
+Reference: ``apex/transformer/testing/standalone_gpt.py`` — the in-tree
+Megatron-style GPT the reference uses to exercise its tensor/pipeline
+parallel stack end-to-end (ColumnParallelLinear qkv/fc1, RowParallelLinear
+proj/fc2, VocabParallelEmbedding, vocab-parallel cross entropy, causal
+fused softmax). BASELINE config #5 benchmarks exactly this model at TP=8.
+
+TPU-first design choices (vs. the reference's nn.Module stack):
+
+- **Stacked layers + ``lax.scan``**: all transformer-layer params carry a
+  leading ``num_layers`` axis and the depth loop is a scan — compile time
+  is O(1) in depth and the same stack reshapes to ``(pp, L/pp, ...)`` for
+  the collective pipeline schedules with zero re-plumbing.
+- **Two execution paths from one weight layout**: ``apply_gpt`` /
+  ``gpt_loss`` run INSIDE ``parallel_state.shard_map`` and speak the TP
+  collectives (the Megatron path); ``apply_gpt_unsharded`` is plain jnp on
+  the same (full) params — the golden model for parity tests and the
+  single-chip path (no mesh needed).
+- Attention heads are derived from the LOCAL qkv width at trace time, so
+  the same code serves any tp degree without threading tp through shapes.
+- The LM head ties to the (vocab-sharded) word embedding; logits stay
+  vocab-sharded and feed ``vocab_parallel_cross_entropy`` (never a full
+  (b, s, V) softmax — the reference's ``parallel_output=True``).
+- RoPE (``use_rope=True``) or learned absolute positions; causal masking
+  via the flash kernel above the dispatch crossover, the fused
+  upper-triangular softmax below it.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer import tensor_parallel as tp
+from apex_tpu.transformer.functional import (
+    flash_attention,
+    fused_apply_rotary_pos_emb_bhsd,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to a multiple of 128
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden_size: int = 4096
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    use_rope: bool = False           # learned absolute positions otherwise
+    rope_base: float = 10000.0
+    hidden_dropout: float = 0.1      # applied only when rng given
+    # jax.checkpoint each layer block: live activation memory drops from
+    # O(layers) full per-op residual sets to one hidden state per layer
+    # plus recompute — mandatory at gpt_medium scale on one chip (ref
+    # analogue: Megatron's --recompute-granularity)
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt_medium() -> GPTConfig:
+    """GPT-2 medium-class — the BASELINE #5 TP benchmark model."""
+    return GPTConfig(remat=True)
+
+
+def gpt_tiny() -> GPTConfig:
+    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                     num_heads=8, ffn_hidden_size=128,
+                     max_position_embeddings=64)
+
+
+# ---------------------------------------------------------------------------
+# init — full (unsharded) params; stacked on a leading layer axis
+# ---------------------------------------------------------------------------
+
+def _stack(key, n, init_one):
+    return jax.vmap(init_one)(jax.random.split(key, n))
+
+
+def init_gpt(key: jax.Array, cfg: GPTConfig,
+             dtype=jnp.float32) -> Dict[str, Any]:
+    h, f, L = cfg.hidden_size, cfg.ffn_hidden_size, cfg.num_layers
+    k_emb, k_pos, k_layers = jax.random.split(key, 3)
+
+    def dense_init(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype) * math.sqrt(1.0 / fan_in)
+
+    def one_layer(k):
+        ks = jax.random.split(k, 4)
+        return {
+            "ln1": {"weight": jnp.ones((h,), jnp.float32),
+                    "bias": jnp.zeros((h,), jnp.float32)},
+            "qkv": {"kernel": dense_init(ks[0], h, (h, 3 * h)),
+                    "bias": jnp.zeros((3 * h,), dtype)},
+            "out": {"kernel": dense_init(ks[1], h, (h, h)),
+                    "bias": jnp.zeros((h,), dtype)},
+            "ln2": {"weight": jnp.ones((h,), jnp.float32),
+                    "bias": jnp.zeros((h,), jnp.float32)},
+            "fc1": {"kernel": dense_init(ks[2], h, (h, f)),
+                    "bias": jnp.zeros((f,), dtype)},
+            "fc2": {"kernel": dense_init(ks[3], f, (f, h)),
+                    "bias": jnp.zeros((h,), dtype)},
+        }
+
+    params: Dict[str, Any] = {
+        "embedding": {"word": {"embedding": jax.random.normal(
+            k_emb, (cfg.vocab_size, h), dtype) * 0.02}},
+        "layers": _stack(k_layers, L, one_layer),
+        "final_ln": {"weight": jnp.ones((h,), jnp.float32),
+                     "bias": jnp.zeros((h,), jnp.float32)},
+    }
+    if not cfg.use_rope:
+        params["embedding"]["position"] = {"embedding": jax.random.normal(
+            k_pos, (cfg.max_position_embeddings, h), dtype) * 0.02}
+    return params
+
+
+def gpt_partition_specs(cfg: GPTConfig) -> Dict[str, Any]:
+    """Megatron TP layout over the ``model`` axis (layer leaves carry the
+    leading stacked-layer dim)."""
+    from jax.sharding import PartitionSpec as P
+
+    t = ps.TENSOR_AXIS
+    specs = {
+        "embedding": {"word": {"embedding": P(t, None)}},
+        "layers": {
+            "ln1": {"weight": P(None), "bias": P(None)},
+            "qkv": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "out": {"kernel": P(None, t, None), "bias": P(None)},
+            "ln2": {"weight": P(None), "bias": P(None)},
+            "fc1": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "fc2": {"kernel": P(None, t, None), "bias": P(None)},
+        },
+        "final_ln": {"weight": P(), "bias": P()},
+    }
+    if not cfg.use_rope:
+        specs["embedding"]["position"] = {"embedding": P()}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# shared block math (parameterized by the linear/embedding implementations)
+# ---------------------------------------------------------------------------
+
+def _ln(p, x, eps):
+    return fused_layer_norm_affine(x, p["weight"], p["bias"],
+                                   x.shape[-1], eps).astype(x.dtype)
+
+
+def _causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
+                      rope_freqs: Optional[jax.Array]) -> jax.Array:
+    """(b, s, 3*h_local) -> (b, s, h_local); heads derived from the local
+    width so the same code runs at any tp degree.
+
+    qkv column layout is HEAD-MAJOR: ``[head0: q k v | head1: q k v | …]``
+    (Megatron's storage order) — a contiguous column shard of the fused
+    qkv kernel then holds whole heads, which is what makes plain
+    ColumnParallelLinear sharding correct. A ``[Q | K | V]``-major layout
+    would hand each rank slices of unrelated heads.
+    """
+    b, s, w = q_k_v.shape
+    hd = cfg.head_dim
+    nh_local = w // (3 * hd)
+    qkv = q_k_v.reshape(b, s, nh_local, 3, hd)
+    q, k, v = (qkv[:, :, :, j].transpose(0, 2, 1, 3) for j in range(3))
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs)
+    ctx = flash_attention(q, k, v, causal=True,
+                          softmax_scale=1.0 / math.sqrt(hd))
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, nh_local * hd)
+
+
+def _block(lp, x, cfg, rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
+           dropout_rng=None):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+    att = _causal_attention(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
+                                                  cfg.layer_norm_eps)),
+                            cfg, rope_freqs)
+    att = out_fn(lp["out"], att)
+    att = _maybe_dropout(att, cfg.hidden_dropout, dropout_rng, 0)
+    x = x + att
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    mlp = _maybe_dropout(mlp, cfg.hidden_dropout, dropout_rng, 1)
+    return x + mlp
+
+
+def _maybe_dropout(x, rate, rng, salt):
+    if rng is None or rate <= 0:
+        return x
+    keep = jax.random.bernoulli(jax.random.fold_in(rng, salt),
+                                1 - rate, x.shape)
+    return x * keep / (1 - rate)
+
+
+def _rope_or_none(cfg: GPTConfig, s: int):
+    if not cfg.use_rope:
+        return None
+    return rope_frequencies(cfg.head_dim, s, cfg.rope_base)
+
+
+def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
+                 dropout_rng):
+    """Depth loop: lax.scan over the stacked layer leaves, optionally
+    rematerialized per layer (``cfg.remat``)."""
+    def block(lp, x, rng):
+        return _block(lp, x, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
+                      dropout_rng=rng)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    if dropout_rng is None:
+        x, _ = lax.scan(lambda x, lp: (block(lp, x, None), None),
+                        x, layers)
+    else:
+        x, _ = lax.scan(
+            lambda x, sl: (block(sl[0], x, sl[1]), None), x,
+            (layers, jax.random.split(dropout_rng, cfg.num_layers)))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel path — call inside parallel_state.shard_map
+# ---------------------------------------------------------------------------
+
+def _tied_lm_logits(hidden: jax.Array, table_local: jax.Array) -> jax.Array:
+    """hidden (replicated) @ local-vocab-shard.T — a ColumnParallelLinear
+    in disguise: the input must pass through copy_to_region so the
+    BACKWARD all-reduces dhidden across TP ranks (each rank's dlogits @
+    table_local is only its vocab slice's partial sum). Forward is the
+    identity."""
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    hidden = mappings.copy_to_tensor_model_parallel_region(hidden)
+    return jnp.dot(hidden, table_local.astype(hidden.dtype).T).astype(
+        jnp.float32)
+
+
+class GPTModel:
+    """Bundles the TP layer objects (Column/Row/VocabParallel) for one
+    config. ``apply``/``loss`` run inside shard_map; ``init`` and
+    ``partition_specs`` describe the full params."""
+
+    def __init__(self, cfg: GPTConfig, tp_size: Optional[int] = None):
+        self.cfg = cfg
+        h, f = cfg.hidden_size, cfg.ffn_hidden_size
+        t = tp_size if tp_size is not None else \
+            ps.get_tensor_model_parallel_world_size()
+        if cfg.num_heads % t:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by tp {t} "
+                "(attention heads shard over the model axis)")
+        self.qkv = tp.ColumnParallelLinear(h, 3 * h, gather_output=False,
+                                           tp_size=tp_size)
+        self.out = tp.RowParallelLinear(h, h, input_is_parallel=True,
+                                        tp_size=tp_size)
+        self.fc1 = tp.ColumnParallelLinear(h, f, gather_output=False,
+                                           tp_size=tp_size)
+        self.fc2 = tp.RowParallelLinear(f, h, input_is_parallel=True,
+                                        tp_size=tp_size)
+        self.embed = tp.VocabParallelEmbedding(cfg.vocab_size, h,
+                                               tp_size=tp_size)
+
+    def init(self, key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+        return init_gpt(key, self.cfg, dtype)
+
+    def partition_specs(self) -> Dict[str, Any]:
+        return gpt_partition_specs(self.cfg)
+
+    def apply(self, params: Dict[str, Any], input_ids: jax.Array,
+              *, dropout_rng: Optional[jax.Array] = None,
+              compute_dtype=None) -> jax.Array:
+        """ids (b, s) -> hidden (b, s, h). Inside shard_map over the
+        ``model`` axis (tp=1 mesh is fine)."""
+        cfg = self.cfg
+        b, s = input_ids.shape
+        x = self.embed.apply(params["embedding"]["word"], input_ids)
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        if not cfg.use_rope:
+            pos = params["embedding"]["position"]["embedding"][:s]
+            x = x + pos.astype(x.dtype)[None]
+        freqs = _rope_or_none(cfg, s)
+        x = _scan_layers(x, params["layers"], cfg, freqs,
+                         self.qkv.apply, self.out.apply,
+                         self.fc1.apply, self.fc2.apply, dropout_rng)
+        return _ln(params["final_ln"], x, cfg.layer_norm_eps)
+
+    def logits_local(self, params: Dict[str, Any],
+                     hidden: jax.Array) -> jax.Array:
+        """Tied LM head: (b, s, h) -> vocab-SHARDED logits (b, s, V/tp),
+        in rank order (the ``parallel_output=True`` convention)."""
+        table = params["embedding"]["word"]["embedding"]
+        return _tied_lm_logits(hidden, table)
+
+    def loss(self, params: Dict[str, Any], input_ids: jax.Array,
+             labels: jax.Array, *,
+             dropout_rng: Optional[jax.Array] = None,
+             compute_dtype=None) -> jax.Array:
+        """Mean next-token loss via vocab-parallel CE (labels = targets,
+        NOT shifted here — shift upstream, reference convention)."""
+        from apex_tpu.transformer.tensor_parallel import (
+            vocab_parallel_cross_entropy,
+        )
+
+        hidden = self.apply(params, input_ids, dropout_rng=dropout_rng,
+                            compute_dtype=compute_dtype)
+        logits = self.logits_local(params, hidden)
+        return vocab_parallel_cross_entropy(logits, labels).mean()
+
+
+# ---------------------------------------------------------------------------
+# unsharded golden path — plain jnp, no mesh
+# ---------------------------------------------------------------------------
+
+def apply_gpt_unsharded(params: Dict[str, Any], cfg: GPTConfig,
+                        input_ids: jax.Array,
+                        *, dropout_rng: Optional[jax.Array] = None,
+                        compute_dtype=None) -> jax.Array:
+    b, s = input_ids.shape
+    table = params["embedding"]["word"]["embedding"]
+    if compute_dtype is not None:
+        table = table.astype(compute_dtype)
+    x = jnp.take(table, input_ids, axis=0)
+    if not cfg.use_rope:
+        pos = params["embedding"]["position"]["embedding"][:s]
+        x = x + pos.astype(x.dtype)[None]
+    freqs = _rope_or_none(cfg, s)
+
+    def dense(p, x):
+        return jnp.dot(x, p["kernel"].astype(x.dtype)) \
+            + p["bias"].astype(x.dtype)
+
+    x = _scan_layers(x, params["layers"], cfg, freqs,
+                     dense, dense, dense, dense, dropout_rng)
+    return _ln(params["final_ln"], x, cfg.layer_norm_eps)
+
+
+def gpt_loss_unsharded(params: Dict[str, Any], cfg: GPTConfig,
+                       input_ids: jax.Array, labels: jax.Array,
+                       *, dropout_rng: Optional[jax.Array] = None,
+                       compute_dtype=None) -> jax.Array:
+    hidden = apply_gpt_unsharded(params, cfg, input_ids,
+                                 dropout_rng=dropout_rng,
+                                 compute_dtype=compute_dtype)
+    table = params["embedding"]["word"]["embedding"]
+    logits = jnp.dot(hidden, table.astype(hidden.dtype).T).astype(
+        jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+# ---------------------------------------------------------------------------
+# pipeline adapter — {"embed", "stages", "head"} layout for the schedules
+# ---------------------------------------------------------------------------
+
+def gpt_to_pipeline_params(params: Dict[str, Any], cfg: GPTConfig,
+                           pp: int, vpp: Optional[int] = None
+                           ) -> Dict[str, Any]:
+    """Reshape the stacked ``(L, ...)`` layer leaves into the schedules'
+    stage stack: ``(pp, L/pp, ...)``, or ``(vpp, pp, L/(pp*vpp), ...)``
+    with the reference's round-robin chunk order (chunk c on device
+    c % pp, lane c // pp)."""
+    L = cfg.num_layers
+    chunks = pp * (vpp or 1)
+    if L % chunks:
+        raise ValueError(f"num_layers {L} not divisible by {chunks}")
+    per = L // chunks
+
+    def reshape(a):
+        if vpp is None:
+            return a.reshape((pp, per) + a.shape[1:])
+        # layer l -> chunk l // per; chunk c -> (lane c // pp, dev c % pp)
+        c_first = a.reshape((chunks, per) + a.shape[1:])
+        return c_first.reshape((vpp, pp, per) + a.shape[1:])
+
+    return {
+        "embed": params["embedding"],
+        "stages": jax.tree.map(reshape, params["layers"]),
+        "head": {"final_ln": params["final_ln"],
+                 "word": params["embedding"]["word"]},
+    }
+
+
+def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
+    """A ``PipelineModel`` over the TP block — runs inside shard_map over
+    BOTH the pipe and model axes (tp×pp)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        PipelineModel,
+    )
+    from apex_tpu.transformer.tensor_parallel import (
+        vocab_parallel_cross_entropy,
+    )
+
+    cfg = model.cfg
+
+    def embed_fn(embed_params, mb):
+        ids = mb["input_ids"]
+        x = model.embed.apply(embed_params["word"], ids)
+        if not cfg.use_rope:
+            pos = embed_params["position"]["embedding"][:ids.shape[1]]
+            x = x + pos.astype(x.dtype)[None]
+        return x
+
+    def stage_fn(stage_params, x):
+        freqs = _rope_or_none(cfg, x.shape[1])
+
+        def body(x, lp):
+            return _block(lp, x, cfg, freqs,
+                          model.qkv.apply, model.out.apply,
+                          model.fc1.apply, model.fc2.apply), None
+
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    def loss_fn(head_params, hidden, mb):
+        hidden = _ln(head_params["final_ln"], hidden, cfg.layer_norm_eps)
+        logits = _tied_lm_logits(hidden, head_params["word"]["embedding"])
+        return vocab_parallel_cross_entropy(logits, mb["labels"]).mean()
+
+    return PipelineModel(embed_fn, stage_fn, loss_fn)
+
+
+# ---------------------------------------------------------------------------
+# bench hook (BASELINE config #5)
+# ---------------------------------------------------------------------------
+
+def gpt_tp_bench(on_tpu: bool, n_devices: int
+                 ) -> Tuple[Any, Any, Any, int]:
+    """Returns (body, init_state, fetch, global_batch) for bench.py:
+    a full TP train step (loss, grads inside shard_map; FusedAdam update)
+    on a tp=n mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = gpt_medium() if on_tpu else gpt_tiny()
+    batch, seq = (8, 1024) if on_tpu else (2, 32)
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    labels = jnp.zeros((batch, seq), jnp.int32)
+    if n_devices == 1:
+        # tp=1: every TP collective is the identity — run the unsharded
+        # path so the step compiles without topology metadata (the axon
+        # relay's chipless AOT helper cannot resolve host bounds for
+        # mesh-collective programs; the CPU rig covers the collectives)
+        params = init_gpt(jax.random.PRNGKey(0), cfg)
+        opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+        opt_state = opt.init(params)
+        vg = jax.value_and_grad(
+            lambda p: gpt_loss_unsharded(p, cfg, ids, labels))
+
+        def body1(state):
+            p, o = state
+            _, grads = vg(p)
+            return opt.step(grads, p, o)
+
+        return (body1, (params, opt_state),
+                lambda s: jnp.sum(s[0]["final_ln"]["weight"]), batch)
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=n_devices)
+    model = GPTModel(cfg, tp_size=n_devices)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    specs = model.partition_specs()
+    shard = lambda tree, sp: jax.tree.map(  # noqa: E731
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, sp)
+    params = shard(params, specs)
+    opt_state = opt_state._replace(m=shard(opt_state.m, specs),
+                                   v=shard(opt_state.v, specs))
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    labels = jnp.zeros((batch, seq), jnp.int32)
+
+    loss_grad = ps.shard_map(
+        jax.value_and_grad(model.loss, argnums=0), mesh=mesh,
+        in_specs=(specs, P(), P()),
+        out_specs=(P(), specs))
+
+    def body(state):
+        p, o = state
+        loss, grads = loss_grad(p, ids, labels)
+        p, o = opt.step(grads, p, o)
+        return (p, o)
+
+    def fetch(state):
+        return jnp.sum(state[0]["final_ln"]["weight"])
+
+    return body, (params, opt_state), fetch, batch
