@@ -1,0 +1,10 @@
+"""Distributed (ZeRO-style) optimizers (ref: ``apex/contrib/optimizers``)."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedAdamState,
+    DistributedFusedAdam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLAMB,
+    DistributedLambState,
+)

@@ -1,0 +1,165 @@
+"""ZeRO distributed-optimizer tests (ref:
+``apex/contrib/test/optimizers/test_distributed_fused_adam.py`` — parity
+of DistributedFusedAdam against single-process Adam, plus the sharded
+state-memory claim)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.transformer import parallel_state as ps
+
+DP = 8
+
+
+def make_params(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "dense": {"w": jax.random.normal(k1, (64, 32)),
+                  "b": jnp.zeros((32,))},
+        "emb": jax.random.normal(k2, (100, 64)) * 0.1,
+        "scale": jax.random.normal(k3, (7,)),
+    }
+
+
+def per_rank_grads(key, params, n=DP):
+    """(n, ...) stacked per-rank grads whose mean is the DDP gradient."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    stacked = [jax.random.normal(k, (n,) + l.shape) * 0.1
+               for k, l in zip(keys, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def dp_mesh():
+    return ps.initialize_model_parallel()  # all 8 devices on the data axis
+
+
+def _zero_step(opt, params, opt_state, grads_stacked, **kw):
+    """Run opt.step inside a dp=8 shard_map; grads arrive rank-local."""
+    sspec = opt.partition_spec()
+
+    def body(g, st):
+        return opt.step(g, params, st, **kw)
+
+    return ps.shard_map(
+        body,
+        in_specs=(jax.tree.map(lambda _: P(ps.DATA_AXIS), grads_stacked),
+                  sspec),
+        out_specs=(jax.tree.map(lambda _: P(), params), sspec))(
+        jax.tree.map(lambda a: a, grads_stacked), opt_state)
+
+
+@pytest.mark.parametrize("opt_cls,ref_cls,kw", [
+    (DistributedFusedAdam, FusedAdam, dict(weight_decay=0.01)),
+    (DistributedFusedAdam, FusedAdam, dict(adam_w_mode=False,
+                                           weight_decay=0.1)),
+    (DistributedFusedLAMB, FusedLAMB, dict(weight_decay=0.01)),
+])
+def test_matches_unsharded_reference(opt_cls, ref_cls, kw):
+    """Several ZeRO steps == the replicated fused optimizer stepping on
+    the rank-MEAN gradient."""
+    mesh = dp_mesh()
+    params = make_params(jax.random.PRNGKey(0))
+    opt = opt_cls(lr=1e-2, dp_size=DP, **kw)
+    ref = ref_cls(lr=1e-2, **kw)
+    st = opt.init(params)
+    ref_params, ref_st = params, ref.init(params)
+
+    for i in range(3):
+        gs = per_rank_grads(jax.random.PRNGKey(10 + i), params)
+        new_params, st = _zero_step(opt, params, st, gs)
+        mean_g = jax.tree.map(lambda a: a.mean(0), gs)
+        if ref_cls is FusedLAMB:
+            # the distributed grad-norm clip sees the mean grad too
+            ref_params, ref_st = ref.step(mean_g, ref_params, ref_st)
+        else:
+            ref_params, ref_st = ref.step(mean_g, ref_params, ref_st)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            new_params, ref_params)
+        params = new_params
+
+
+def test_overflow_skips_everywhere():
+    mesh = dp_mesh()
+    params = make_params(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(lr=1e-2, dp_size=DP)
+    st = opt.init(params)
+    gs = per_rank_grads(jax.random.PRNGKey(1), params)
+
+    # found_inf True on ONE rank only must freeze params + state globally
+    flags = jnp.arange(DP) == 3
+
+    def body(g, f, st):
+        return opt.step(g, params, st, found_inf=f[0])
+
+    sspec = opt.partition_spec()
+    new_params, new_st = ps.shard_map(
+        body,
+        in_specs=(jax.tree.map(lambda _: P(ps.DATA_AXIS), gs),
+                  P(ps.DATA_AXIS), sspec),
+        out_specs=(jax.tree.map(lambda _: P(), params), sspec))(
+        gs, flags, st)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), new_params, params)
+    assert int(new_st.step) == 0
+    np.testing.assert_array_equal(np.asarray(new_st.m),
+                                  np.asarray(st.m))
+
+
+def test_state_is_sharded_at_rest():
+    """device_put with partition_spec → each device stores ~1/dp of the
+    optimizer state (the ZeRO memory claim, asserted in bytes)."""
+    mesh = dp_mesh()
+    params = make_params(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(dp_size=DP)
+    st = opt.init(params)
+    sharded_m = jax.device_put(
+        st.m, NamedSharding(mesh, opt.partition_spec().m))
+    shard_bytes = sharded_m.addressable_shards[0].data.nbytes
+    assert shard_bytes * DP == st.m.nbytes
+    assert opt.state_bytes_per_device(params) == 3 * shard_bytes
+
+
+def test_grad_scale_unscales():
+    """grad_scale=1/S on S-scaled grads == unscaled run (multiply
+    convention)."""
+    mesh = dp_mesh()
+    params = make_params(jax.random.PRNGKey(0))
+    gs = per_rank_grads(jax.random.PRNGKey(2), params)
+    S = 2.0 ** 12
+
+    opt = DistributedFusedAdam(lr=1e-2, dp_size=DP)
+    p_plain, _ = _zero_step(opt, params, opt.init(params), gs)
+    opt2 = DistributedFusedAdam(lr=1e-2, dp_size=DP)
+    p_scaled, _ = _zero_step(
+        opt2, params, opt2.init(params),
+        jax.tree.map(lambda a: a * S, gs), grad_scale=1.0 / S)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        p_plain, p_scaled)
+
+
+def test_lamb_trust_ratio_spans_shards():
+    """A tensor bigger than one shard (emb: 100x64 = 50 rows over 8 ranks)
+    still gets ONE coherent trust ratio — compare against FusedLAMB where
+    each leaf is a whole tensor."""
+    mesh = dp_mesh()
+    params = make_params(jax.random.PRNGKey(3))
+    gs = per_rank_grads(jax.random.PRNGKey(4), params)
+    opt = DistributedFusedLAMB(lr=5e-2, weight_decay=0.01, dp_size=DP)
+    ref = FusedLAMB(lr=5e-2, weight_decay=0.01)
+    got, _ = _zero_step(opt, params, opt.init(params), gs)
+    want, _ = ref.step(jax.tree.map(lambda a: a.mean(0), gs), params,
+                       ref.init(params))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6), got, want)
