@@ -1,0 +1,6 @@
+"""Module-level fused multi-head attention (ref: ``apex/contrib/multihead_attn``)."""
+
+from apex_tpu.contrib.multihead_attn.multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)

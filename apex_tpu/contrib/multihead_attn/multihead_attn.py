@@ -1,0 +1,207 @@
+"""Fused MHA modules over the flash-attention kernel.
+
+Reference: ``apex/contrib/multihead_attn/self_multihead_attn.py`` and
+``encdec_multihead_attn.py`` (impl='fast'; CUDA in
+``csrc/multihead_attn/*``) — module-level attention with packed
+projection weights, optional fused residual+LayerNorm input
+(``include_norm_add=True``, the ``*_norm_add`` kernel variants), and
+attention-probability dropout replayed from saved RNG state in backward.
+
+TPU mapping: the giant fused CUDA forward (QKV GEMM → softmax → dropout →
+PV GEMM → out GEMM) is the flash-attention Pallas kernel plus XLA-fused
+projections; dropout replay is the kernel's counter-hash (no mask
+storage). The norm_add variant's "fused" LN+residual is ordinary code —
+XLA fuses the add into adjacent ops, so a dedicated kernel would buy
+nothing (the "let XLA fuse" rule).
+
+Conventions kept from the reference:
+- tensors are sequence-first ``(seq, batch, embed)`` (Megatron layout);
+- qkv/kv projection weights are packed; like the in-tree GPT the packing
+  is HEAD-MAJOR (``[head0: q k v | head1: …]``) so a future column shard
+  holds whole heads;
+- ``bias=False`` default (the fast impl's default);
+- ``key_padding_mask`` is (batch, src_len) with 1 = ATTEND (the package's
+  BERT convention; the reference's byte mask marks pads — invert when
+  porting);
+- returns only the attention output (fast impl returns
+  ``(output, None)`` for weights; per-head weight export is unsupported
+  here because flash never materializes them).
+"""
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.transformer.functional import flash_attention
+
+
+def _init_kernel(key, shape, fan_in, dtype):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+
+
+def _split_heads(x: jax.Array, nh: int) -> jax.Array:
+    """(s, b, nh*hd) -> (b, nh, s, hd)."""
+    s, b, w = x.shape
+    return x.reshape(s, b, nh, w // nh).transpose(1, 2, 0, 3)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    """(b, nh, s, hd) -> (s, b, nh*hd)."""
+    b, nh, s, hd = x.shape
+    return x.transpose(2, 0, 1, 3).reshape(s, b, nh * hd)
+
+
+def _output_dropout(x, rate, rng):
+    if rng is None or rate <= 0:
+        return x
+    keep = jax.random.bernoulli(rng, 1 - rate, x.shape)
+    return x * keep / (1 - rate)
+
+
+class _MhaBase:
+    def __init__(self, embed_dim: int, num_heads: int, *,
+                 dropout: float = 0.0, bias: bool = False,
+                 include_norm_add: bool = False,
+                 params_dtype=jnp.float32):
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"embed_dim {embed_dim} not divisible by num_heads "
+                f"{num_heads}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.use_bias = bias
+        self.include_norm_add = include_norm_add
+        self.params_dtype = params_dtype
+        self.scaling = self.head_dim ** -0.5
+
+    def _norm_params(self):
+        if not self.include_norm_add:
+            return {}
+        return {"layernorm": {
+            "weight": jnp.ones((self.embed_dim,), jnp.float32),
+            "bias": jnp.zeros((self.embed_dim,), jnp.float32)}}
+
+    def _maybe_norm(self, params, x):
+        if not self.include_norm_add:
+            return x
+        p = params["layernorm"]
+        return fused_layer_norm_affine(
+            x, p["weight"], p["bias"], self.embed_dim, 1e-5).astype(x.dtype)
+
+    def _proj(self, p, x):
+        y = jnp.dot(x, p["kernel"].astype(x.dtype))
+        if "bias" in p:
+            y = y + p["bias"].astype(y.dtype)
+        return y
+
+    def _attend(self, q, k, v, key_padding_mask, attn_mask_causal,
+                dropout_rng, is_training):
+        rate = self.dropout if (is_training and dropout_rng is not None) \
+            else 0.0
+        rng = dropout_rng if rate > 0 else None
+        return flash_attention(
+            q, k, v, key_padding_mask, causal=attn_mask_causal,
+            softmax_scale=self.scaling,
+            dropout_rate=rate, dropout_rng=rng)
+
+
+class SelfMultiheadAttn(_MhaBase):
+    """Self-attention with one packed qkv projection (ref:
+    ``SelfMultiheadAttn(impl='fast')`` / ``*_norm_add`` when
+    ``include_norm_add=True``)."""
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(key)
+        h = self.embed_dim
+        p = {
+            "qkv": {"kernel": _init_kernel(k1, (h, 3 * h), h,
+                                           self.params_dtype)},
+            "out": {"kernel": _init_kernel(k2, (h, h), h,
+                                           self.params_dtype)},
+        }
+        if self.use_bias:
+            p["qkv"]["bias"] = jnp.zeros((3 * h,), self.params_dtype)
+            p["out"]["bias"] = jnp.zeros((h,), self.params_dtype)
+        p.update(self._norm_params())
+        return p
+
+    def apply(self, params: Dict[str, Any], query: jax.Array, *,
+              key_padding_mask: Optional[jax.Array] = None,
+              attn_mask_causal: bool = False,
+              is_training: bool = True,
+              dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+        """query: (tgt_len, batch, embed) -> same shape."""
+        x = self._maybe_norm(params, query)
+        qkv = self._proj(params["qkv"], x)        # (s, b, 3h) head-major
+        s, b, _ = qkv.shape
+        nh, hd = self.num_heads, self.head_dim
+        qkv = qkv.reshape(s, b, nh, 3, hd)
+        q, k, v = (qkv[:, :, :, j].transpose(1, 2, 0, 3) for j in range(3))
+        rngs = (jax.random.split(dropout_rng)
+                if dropout_rng is not None else (None, None))
+        ctx = self._attend(q, k, v, key_padding_mask, attn_mask_causal,
+                           rngs[0], is_training)
+        out = self._proj(params["out"], _merge_heads(ctx))
+        if self.include_norm_add:
+            # reference norm_add epilogue: dropout(output) + residual
+            if is_training:
+                out = _output_dropout(out, self.dropout, rngs[1])
+            out = out + query
+        return out
+
+    __call__ = apply
+
+
+class EncdecMultiheadAttn(_MhaBase):
+    """Cross-attention: q from the decoder query, packed kv from the
+    encoder output (ref: ``EncdecMultiheadAttn(impl='fast')``)."""
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        k1, k2, k3 = jax.random.split(key, 3)
+        h = self.embed_dim
+        p = {
+            "q": {"kernel": _init_kernel(k1, (h, h), h, self.params_dtype)},
+            "kv": {"kernel": _init_kernel(k2, (h, 2 * h), h,
+                                          self.params_dtype)},
+            "out": {"kernel": _init_kernel(k3, (h, h), h,
+                                           self.params_dtype)},
+        }
+        if self.use_bias:
+            p["q"]["bias"] = jnp.zeros((h,), self.params_dtype)
+            p["kv"]["bias"] = jnp.zeros((2 * h,), self.params_dtype)
+            p["out"]["bias"] = jnp.zeros((h,), self.params_dtype)
+        p.update(self._norm_params())
+        return p
+
+    def apply(self, params: Dict[str, Any], query: jax.Array,
+              key: jax.Array, *,
+              key_padding_mask: Optional[jax.Array] = None,
+              attn_mask_causal: bool = False,
+              is_training: bool = True,
+              dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+        """query: (tgt_len, b, h); key: (src_len, b, h) (the reference
+        passes the encoder output as both key and value)."""
+        x = self._maybe_norm(params, query)
+        nh, hd = self.num_heads, self.head_dim
+        q = _split_heads(self._proj(params["q"], x), nh)
+        kv = self._proj(params["kv"], key)        # (s_k, b, 2h) head-major
+        sk, b, _ = kv.shape
+        kv = kv.reshape(sk, b, nh, 2, hd)
+        k_, v_ = (kv[:, :, :, j].transpose(1, 2, 0, 3) for j in range(2))
+        rngs = (jax.random.split(dropout_rng)
+                if dropout_rng is not None else (None, None))
+        ctx = self._attend(q, k_, v_, key_padding_mask, attn_mask_causal,
+                           rngs[0], is_training)
+        out = self._proj(params["out"], _merge_heads(ctx))
+        if self.include_norm_add:
+            if is_training:
+                out = _output_dropout(out, self.dropout, rngs[1])
+            out = out + query
+        return out
+
+    __call__ = apply

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.amp.autocast import cast_args
+
 
 def lecun_normal(key, shape, fan_in, dtype=jnp.float32):
     return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
@@ -44,7 +46,11 @@ def dense(params: dict, x: jax.Array) -> jax.Array:
     # transpose (backward) call dot/conv with an f32 cotangent against a
     # bf16 kernel (dtype-mismatch); the MXU accumulates bf16 matmuls in f32
     # internally regardless.
-    y = jnp.dot(x, params["kernel"].astype(x.dtype))
+    # O1: under amp.autocast the op-policy casts both operands to the
+    # compute dtype (dense is on FP16_FUNCS); outside the context this is
+    # the identity (ref: apex/amp/wrap.py cached_cast over torch.nn.linear)
+    x, kernel = cast_args("dense", x, params["kernel"])
+    y = jnp.dot(x, kernel.astype(x.dtype))
     if "bias" in params:
         y = y + params["bias"].astype(x.dtype)
     return y
@@ -61,8 +67,9 @@ def init_conv(key, in_ch: int, out_ch: int, kernel: Tuple[int, int],
 
 def conv(params: dict, x: jax.Array, stride: int = 1,
          padding="SAME") -> jax.Array:
+    x, kernel = cast_args("conv2d", x, params["kernel"])
     return lax.conv_general_dilated(
-        x, params["kernel"].astype(x.dtype),
+        x, kernel.astype(x.dtype),
         window_strides=(stride, stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 

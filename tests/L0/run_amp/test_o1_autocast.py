@@ -1,0 +1,123 @@
+"""O1 op-policy autocast end-to-end (ref: ``apex/amp`` O1 — cached casts
+installed over torch functions; here the op library consults
+``amp.autocast.cast_args``). Asserts the dtype contract: matmuls/convs in
+the compute dtype, norms/softmax fp32 inside, params untouched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.amp.autocast import autocast, cast_args
+from apex_tpu.models import layers as L
+
+
+def test_cast_args_policies():
+    x32 = jnp.ones((4, 4), jnp.float32)
+    xb = jnp.ones((4, 4), jnp.bfloat16)
+    # outside any context: identity
+    assert cast_args("dense", x32)[0].dtype == jnp.float32
+    with autocast(jnp.bfloat16):
+        # fp16-list op: cast down
+        assert cast_args("dense", x32)[0].dtype == jnp.bfloat16
+        # fp32-list op: cast up
+        assert cast_args("softmax", xb)[0].dtype == jnp.float32
+        # promote: widest wins
+        a, b = cast_args("add", xb, x32)
+        assert a.dtype == b.dtype == jnp.float32
+        # non-float args pass through
+        ids = jnp.ones((4,), jnp.int32)
+        assert cast_args("dense", ids)[0].dtype == jnp.int32
+    with autocast(enabled=False):
+        assert cast_args("dense", x32)[0].dtype == jnp.float32
+
+
+def test_dense_runs_in_bf16_under_autocast():
+    p = L.init_dense(jax.random.PRNGKey(0), 16, 8)  # fp32 params
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16), jnp.float32)
+    assert L.dense(p, x).dtype == jnp.float32
+    with autocast(jnp.bfloat16):
+        y = L.dense(p, x)
+    assert y.dtype == jnp.bfloat16
+    assert p["kernel"].dtype == jnp.float32  # params untouched
+
+
+def test_conv_under_autocast():
+    p = L.init_conv(jax.random.PRNGKey(0), 3, 8, (3, 3))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
+    with autocast(jnp.bfloat16):
+        assert L.conv(p, x).dtype == jnp.bfloat16
+    assert L.conv(p, x).dtype == jnp.float32
+
+
+def test_o1_handle_enables_autocast_o0_does_not():
+    h1 = amp.initialize(opt_level="O1", verbosity=0)
+    p = L.init_dense(jax.random.PRNGKey(0), 16, 8)
+    x = jnp.ones((2, 16), jnp.float32)
+    with h1.autocast():
+        assert L.dense(p, x).dtype == jnp.bfloat16
+    h0 = amp.initialize(opt_level="O0", verbosity=0)
+    with h0.autocast():
+        assert L.dense(p, x).dtype == jnp.float32
+
+
+def test_o1_end_to_end_bert_step():
+    """Full O1 train step on tiny BERT: fp32 master params, op-policy
+    casting inside the loss, dynamic scaler — loss finite, close to the
+    fp32 run, grads fp32 like the params."""
+    from apex_tpu.models import apply_bert, bert_tiny, init_bert, mlm_loss
+
+    cfg = bert_tiny()
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                             cfg.vocab_size)
+    mask = jnp.ones((2, 32), jnp.int32)
+
+    def loss_fn(p):
+        out = apply_bert(p, cfg, ids, mask)
+        return mlm_loss(out["mlm_logits"], ids, mask)
+
+    h = amp.initialize(opt_level="O1", loss_scale="dynamic", verbosity=0)
+    state = h.init_state()
+    with h.autocast():
+        # O1 keeps master weights fp32 — no cast_model
+        loss, grads, found_inf, state = h.value_and_grad(loss_fn)(
+            params, state)
+    loss32 = loss_fn(params)
+
+    assert loss.dtype == jnp.float32
+    assert not bool(found_inf)
+    # bf16 matmuls: tolerance is bf16-sized, and the runs must differ
+    # (proof the cast actually happened)
+    np.testing.assert_allclose(float(loss), float(loss32), rtol=0.05)
+    assert float(loss) != float(loss32)
+    for g in jax.tree_util.tree_leaves(grads):
+        assert g.dtype == jnp.float32
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_hidden_states_fp32_after_norms_dense_compute_bf16():
+    """Reference O1 semantics: layer_norm is FP32-forced, so the residual
+    stream re-emerges fp32 after every LN even though each dense casts its
+    operands to bf16 (torch O1 behaves identically: linear returns fp16,
+    the next layer_norm returns fp32)."""
+    from apex_tpu.models import apply_bert, bert_tiny, init_bert
+    from apex_tpu.models.layers import dense
+
+    cfg = bert_tiny()
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    with autocast(jnp.bfloat16):
+        out = apply_bert(params, cfg, ids, jnp.ones_like(ids))
+        # the op-level contract that makes O1 fast: dense emits bf16
+        q = dense(params["encoder"][0]["attention"]["qkv"], out["hidden"])
+    assert out["hidden"].dtype == jnp.float32
+    assert q.dtype == jnp.bfloat16
+    assert out["mlm_logits"].dtype == jnp.float32  # loss head stays fp32
+
+    bp, bs = L.init_batchnorm(4)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 4), jnp.bfloat16)
+    with autocast(jnp.bfloat16):
+        y, new_state = L.batchnorm(bp, bs, x, train=True)
+    assert new_state["mean"].dtype == jnp.float32
+    assert new_state["var"].dtype == jnp.float32

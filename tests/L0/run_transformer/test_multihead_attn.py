@@ -1,0 +1,125 @@
+"""Module-level MHA golden tests (ref:
+``apex/contrib/test/multihead_attn/test_self_multihead_attn.py`` /
+``test_encdec_multihead_attn.py`` — fast impl vs a straight-line
+softmax-attention reference)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+
+S, SK, B, H, NH = 16, 24, 2, 64, 8
+HD = H // NH
+
+
+def _ref_attention(q, k, v, scale, mask=None, causal=False):
+    """(b, nh, s, hd) straight-line softmax attention."""
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :] != 0, s, -1e30)
+    if causal:
+        tri = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+        s = jnp.where(tri[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnqk,bnkd->bnqd", p, v)
+
+
+def _ref_self(params, x, mha, mask=None, causal=False):
+    qkv = x @ params["qkv"]["kernel"]
+    s, b, _ = qkv.shape
+    qkv = qkv.reshape(s, b, NH, 3, HD)
+    q, k, v = (qkv[:, :, :, j].transpose(1, 2, 0, 3) for j in range(3))
+    ctx = _ref_attention(q, k, v, mha.scaling, mask, causal)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, H)
+    return ctx @ params["out"]["kernel"]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_self_attn_matches_reference(causal):
+    mha = SelfMultiheadAttn(H, NH)
+    params = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+    got = mha.apply(params, x, attn_mask_causal=causal, is_training=False)
+    want = _ref_self(params, x, mha, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_self_attn_key_padding_mask():
+    mha = SelfMultiheadAttn(H, NH)
+    params = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+    mask = jnp.ones((B, S), jnp.int32).at[:, S // 2:].set(0)
+    got = mha.apply(params, x, key_padding_mask=mask, is_training=False)
+    want = _ref_self(params, x, mha, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_norm_add_variant():
+    """include_norm_add: LN at input, residual add at output — output
+    must equal plain-MHA(LN(x)) + x."""
+    mha = SelfMultiheadAttn(H, NH, include_norm_add=True)
+    plain = SelfMultiheadAttn(H, NH)
+    params = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+
+    xn = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    want = plain.apply({"qkv": params["qkv"], "out": params["out"]},
+                       xn, is_training=False) + x
+    got = mha.apply(params, x, is_training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_encdec_matches_reference():
+    mha = EncdecMultiheadAttn(H, NH)
+    params = mha.init(jax.random.PRNGKey(0))
+    q = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+    enc = jax.random.normal(jax.random.PRNGKey(2), (SK, B, H))
+    got = mha.apply(params, q, enc, is_training=False)
+
+    qh = (q @ params["q"]["kernel"]).reshape(S, B, NH, HD).transpose(
+        1, 2, 0, 3)
+    kv = (enc @ params["kv"]["kernel"]).reshape(SK, B, NH, 2, HD)
+    k, v = (kv[:, :, :, j].transpose(1, 2, 0, 3) for j in range(2))
+    ctx = _ref_attention(qh, k, v, mha.scaling)
+    want = ctx.transpose(2, 0, 1, 3).reshape(S, B, H) \
+        @ params["out"]["kernel"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dropout_deterministic_and_active():
+    mha = SelfMultiheadAttn(H, NH, dropout=0.3)
+    params = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+    a = mha.apply(params, x, dropout_rng=jax.random.PRNGKey(5))
+    b = mha.apply(params, x, dropout_rng=jax.random.PRNGKey(5))
+    c = mha.apply(params, x, dropout_rng=jax.random.PRNGKey(6))
+    d = mha.apply(params, x, is_training=False,
+                  dropout_rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(jnp.max(jnp.abs(a - c))) > 0
+    assert float(jnp.max(jnp.abs(a - d))) > 0  # eval disables dropout
+
+
+def test_gradients_flow():
+    mha = SelfMultiheadAttn(H, NH, bias=True, include_norm_add=True)
+    params = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, B, H))
+
+    g = jax.grad(lambda p: jnp.sum(
+        mha.apply(p, x, attn_mask_causal=True, is_training=False) ** 2))(
+        params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+        assert float(jnp.max(jnp.abs(leaf))) > 0
