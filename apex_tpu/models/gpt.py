@@ -394,6 +394,33 @@ def gpt_to_pipeline_params(params: Dict[str, Any], cfg: GPTConfig,
     }
 
 
+def gpt_pipeline_partition_specs(cfg: GPTConfig,
+                                 vpp: Optional[int] = None):
+    """PartitionSpecs matching ``gpt_to_pipeline_params``: stage leaves
+    gain a leading ``pipe``-sharded stage dim (``(vpp, pp, per, ...)``
+    with vpp) while keeping their Megatron TP shardings; the tied word
+    table stays vocab-sharded over the model axis in BOTH its embed and
+    head copies (a replicated table would make vocab-parallel CE
+    double-count sum_exp — the forward is wrong, not just slow)."""
+    from jax.sharding import PartitionSpec as P
+
+    base = gpt_partition_specs(cfg)
+
+    def stage_spec(p: P) -> P:
+        tail = tuple(p)[1:]  # drop the stacked-L dim's entry
+        if vpp is None:
+            return P(ps.PIPE_AXIS, None, *tail)
+        return P(None, ps.PIPE_AXIS, None, *tail)
+
+    return {
+        "embed": base["embedding"],
+        "stages": jax.tree.map(stage_spec, base["layers"],
+                               is_leaf=lambda x: isinstance(x, P)),
+        "head": {"final_ln": base["final_ln"],
+                 "word": base["embedding"]["word"]},
+    }
+
+
 def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
     """A ``PipelineModel`` over the TP block — runs inside shard_map over
     BOTH the pipe and model axes (tp×pp)."""

@@ -91,11 +91,9 @@ def test_pipeline_gpt_matches_unsharded(pp, vpp):
                if vpp else
                schedules.forward_backward_pipelining_without_interleaving)
 
-    stage_spec = P(None, ps.PIPE_AXIS) if vpp else P(ps.PIPE_AXIS)
-    specs = {"embed": jax.tree.map(lambda _: P(), pipe_params["embed"]),
-             "stages": jax.tree.map(lambda _: stage_spec,
-                                    pipe_params["stages"]),
-             "head": jax.tree.map(lambda _: P(), pipe_params["head"])}
+    from apex_tpu.models.gpt import gpt_pipeline_partition_specs
+
+    specs = gpt_pipeline_partition_specs(cfg, vpp)
 
     kw = {"virtual_pipeline_size": vpp} if vpp else {}
     loss, grads = jax.jit(ps.shard_map(
