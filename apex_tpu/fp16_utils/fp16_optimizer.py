@@ -1,0 +1,101 @@
+"""FP16_Optimizer — the legacy master-weights wrapper.
+
+Reference: ``apex/fp16_utils/fp16_optimizer.py :: FP16_Optimizer`` — wraps
+a torch optimizer, keeps fp32 master copies of fp16 params, scales the
+loss (static or dynamic), copies fp16 grads into fp32, unscales, checks
+overflow, steps the wrapped optimizer on the masters, and copies back.
+
+Functional translation: the wrapper owns a ``FP16OptimizerState``
+(master pytree + inner optimizer state + scaler state); ``scale_loss``
+stands in for ``backward(loss)`` (JAX differentiates the scaled loss —
+there is no .grad buffer to scale in place), and ``step`` performs
+grads→master-grads, unscale, overflow-gated inner step, master→model.
+The wrapped optimizer is any ``apex_tpu.optimizers`` fused optimizer
+(they expose ``init``/``step(grads, params, state, grad_scale,
+found_inf)``). New code should use ``amp.initialize`` (O2); this class
+exists for script parity, same as the reference keeps it.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.fp16_utils.fp16util import (
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+
+
+class FP16OptimizerState(NamedTuple):
+    master: Any
+    inner: Any
+    scaler: LossScalerState
+
+
+class FP16_Optimizer:
+    def __init__(self, optimizer,
+                 static_loss_scale: Union[float, str] = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = LossScaler("dynamic",
+                                          **(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(float(static_loss_scale))
+        self.verbose = verbose
+
+    # -- state ----------------------------------------------------------
+    def init(self, model_params: Any) -> FP16OptimizerState:
+        _, master = prep_param_lists(model_params)
+        return FP16OptimizerState(
+            master=master,
+            inner=self.optimizer.init(master),
+            scaler=self.loss_scaler.init_state())
+
+    def loss_scale(self, state: FP16OptimizerState) -> jnp.ndarray:
+        return self.loss_scaler.loss_scale(state.scaler)
+
+    # -- the backward()/step() pair -------------------------------------
+    def scale_loss(self, loss: jnp.ndarray,
+                   state: FP16OptimizerState) -> jnp.ndarray:
+        """The ``backward(loss)`` analogue: differentiate THIS value (ref
+        scales the loss before .backward() so fp16 grads don't
+        underflow)."""
+        return self.loss_scaler.scale(loss, state.scaler)
+
+    def step(self, grads: Any, model_params: Any,
+             state: FP16OptimizerState, **step_kwargs
+             ) -> Tuple[Any, FP16OptimizerState]:
+        """grads are w.r.t. the SCALED loss in the model (fp16) dtype.
+        Returns (new model params, new state); on overflow the inner step
+        is skipped and the scale halves (dynamic), exactly the
+        reference's ``step``-after-``update_master_grads`` sequence."""
+        master_grads = model_grads_to_master_grads(grads)
+        master_grads, found_inf = self.loss_scaler.unscale(
+            master_grads, state.scaler)
+        new_master, new_inner = self.optimizer.step(
+            master_grads, state.master, state.inner,
+            found_inf=found_inf, **step_kwargs)
+        new_scaler = self.loss_scaler.update_scale(state.scaler, found_inf)
+        new_model = master_params_to_model_params(model_params, new_master)
+        return new_model, FP16OptimizerState(
+            master=new_master, inner=new_inner, scaler=new_scaler)
+
+    # -- checkpoint parity ----------------------------------------------
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        """Pytree-of-arrays dict (ref: ``state_dict`` incl. the loss
+        scaler's dynamic state)."""
+        return {"master": state.master, "inner": state.inner,
+                "scaler": {"loss_scale": state.scaler.loss_scale,
+                           "unskipped": state.scaler.unskipped,
+                           "overflows": state.scaler.overflows}}
+
+    def load_state_dict(self, d: dict) -> FP16OptimizerState:
+        return FP16OptimizerState(
+            master=d["master"], inner=d["inner"],
+            scaler=LossScalerState(**d["scaler"]))

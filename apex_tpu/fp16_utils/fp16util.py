@@ -1,0 +1,55 @@
+"""Functional analogues of ``apex/fp16_utils/fp16util.py``.
+
+The reference mutates ``nn.Module``s in place (``network_to_half``,
+``BN_convert_float``) and copies between ``.data`` buffers
+(``master_params_to_model_params``). Params here are immutable pytrees, so
+each helper is a pure tree transform built on the amp policy engine —
+kept as a distinct API because a generation of training scripts speaks
+it; new code should use :func:`apex_tpu.amp.initialize` (O2) instead,
+exactly as the reference's docs point fp16_utils users at amp.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import policy as _policy
+
+
+def tofp16(params: Any, dtype=jnp.float16) -> Any:
+    """Blanket cast of float leaves (ref: ``tofp16`` module wrapper).
+    On TPU prefer bfloat16 — fp16 is supported but needs loss scaling."""
+    return _policy.cast_params(params, dtype)
+
+
+def network_to_half(params: Any, dtype=jnp.float16) -> Any:
+    """Cast float params to half EXCEPT normalization params (ref:
+    ``network_to_half`` = tofp16 + ``BN_convert_float``; the norm
+    detection reuses amp's keep_batchnorm_fp32 path predicate)."""
+    return _policy.cast_params(params, dtype, keep_batchnorm_fp32=True)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params, fp32 master copy) — ref: ``prep_param_lists``
+    (which also flattens; flattening is the multi-tensor engine's job
+    here and orthogonal to master-weight keeping)."""
+    return params, _policy.master_params(params)
+
+
+def master_params_to_model_params(model_params: Any, master: Any) -> Any:
+    """Cast the fp32 master values into the model params' dtypes (ref:
+    copies master ``.data`` into the fp16 model tensors)."""
+    return jax.tree.map(
+        lambda mp, ma: ma.astype(mp.dtype)
+        if jnp.issubdtype(jnp.asarray(mp).dtype, jnp.floating) else mp,
+        model_params, master)
+
+
+def model_grads_to_master_grads(grads: Any) -> Any:
+    """Upcast fp16 grads to fp32 for the master update (ref: copies
+    ``.grad`` into fp32 buffers)."""
+    return jax.tree.map(
+        lambda g: g.astype(jnp.float32)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+        grads)

@@ -1,0 +1,6 @@
+"""Fused dense blocks (ref: ``apex/fused_dense``)."""
+
+from apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+)

@@ -1,0 +1,3 @@
+"""Fused MLP (ref: ``apex/mlp``)."""
+
+from apex_tpu.mlp.mlp import MLP  # noqa: F401

@@ -1,0 +1,80 @@
+"""MLP block (ref: ``apex/mlp/mlp.py :: class MLP`` over ``mlp_cuda``).
+
+The CUDA extension exists to fuse the whole linear→bias→ReLU chain into
+one kernel launch with a hand-written backward. On TPU that is XLA's
+default behavior: the bias-add and activation fuse into the matmul's
+epilogue, and the chain compiles to back-to-back MXU ops with no
+intermediate HBM round-trips — so this module is the *API*, not a
+kernel. The one knob fusion cannot give you is memory: ``remat=True``
+wraps the chain in ``jax.checkpoint`` (recompute instead of storing the
+per-layer activations), the TPU analogue of the CUDA kernel's fused
+backward reusing forward intermediates.
+"""
+
+import math
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.autocast import cast_args
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+class MLP:
+    """``MLP([in, h1, ..., out])`` — a chain of ``len(sizes)-1`` linear
+    layers with ``activation`` between them (and after the last layer,
+    matching the reference, which applies it uniformly)."""
+
+    def __init__(self, mlp_sizes: Sequence[int], *, bias: bool = True,
+                 activation: str = "relu", relu: bool = True,
+                 params_dtype=jnp.float32, remat: bool = False):
+        if len(mlp_sizes) < 2:
+            raise ValueError("MLP needs at least [in, out] sizes")
+        if activation not in _ACTIVATIONS:
+            raise ValueError(
+                f"activation must be one of {sorted(_ACTIVATIONS)}")
+        if not relu:  # reference back-compat flag
+            activation = "none"
+        self.sizes = list(mlp_sizes)
+        self.use_bias = bias
+        self.activation = activation
+        self.params_dtype = params_dtype
+        self.remat = remat
+
+    def init(self, key: jax.Array) -> List[Dict[str, Any]]:
+        layers = []
+        for k, (fi, fo) in zip(jax.random.split(key, len(self.sizes) - 1),
+                               zip(self.sizes[:-1], self.sizes[1:])):
+            # reference init: uniform(-1/sqrt(fan_in), 1/sqrt(fan_in))
+            bound = 1.0 / math.sqrt(fi)
+            p = {"kernel": jax.random.uniform(
+                k, (fi, fo), self.params_dtype, -bound, bound)}
+            if self.use_bias:
+                p["bias"] = jnp.zeros((fo,), self.params_dtype)
+            layers.append(p)
+        return layers
+
+    def apply(self, params: List[Dict[str, Any]], x: jax.Array
+              ) -> jax.Array:
+        act = _ACTIVATIONS[self.activation]
+
+        def chain(params, x):
+            for p in params:
+                xi, kernel = cast_args("dense", x, p["kernel"])
+                x = jnp.dot(xi, kernel.astype(xi.dtype))
+                if "bias" in p:
+                    x = x + p["bias"].astype(x.dtype)
+                x = act(x)
+            return x
+
+        if self.remat:
+            chain = jax.checkpoint(chain)
+        return chain(params, x)
+
+    __call__ = apply

@@ -1,0 +1,170 @@
+"""fp16_utils / mlp / fused_dense tests (ref: ``tests/L0/run_fp16util``,
+``tests/L0/run_mlp``, ``apex/fused_dense`` tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+)
+from apex_tpu.fused_dense import FusedDense, FusedDenseGeluDense
+from apex_tpu.mlp import MLP
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+def make_params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "dense": {"kernel": jax.random.normal(k, (16, 8)),
+                  "bias": jnp.zeros((8,))},
+        "layernorm": {"weight": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+        "step": jnp.int32(0),
+    }
+
+
+def test_network_to_half_keeps_norms_fp32():
+    half = network_to_half(make_params())
+    assert half["dense"]["kernel"].dtype == jnp.float16
+    assert half["layernorm"]["weight"].dtype == jnp.float32
+    assert half["step"].dtype == jnp.int32  # non-float untouched
+
+
+def test_prep_and_roundtrip():
+    model = network_to_half(make_params())
+    model_p, master = prep_param_lists(model)
+    assert master["dense"]["kernel"].dtype == jnp.float32
+    back = master_params_to_model_params(model_p, master)
+    assert back["dense"]["kernel"].dtype == jnp.float16
+    g = model_grads_to_master_grads(
+        jax.tree.map(lambda a: a.astype(jnp.float16)
+                     if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                     make_params()))
+    assert g["dense"]["kernel"].dtype == jnp.float32
+
+
+def test_fp16_optimizer_matches_fp32_sgd():
+    """Static scale 128: scaled-loss grads through FP16_Optimizer must
+    track the plain fp32 SGD trajectory within fp16 tolerance."""
+    model32 = {"w": jax.random.normal(jax.random.PRNGKey(1), (32, 4))}
+    model16 = jax.tree.map(lambda a: a.astype(jnp.float16), model32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 32))
+
+    def loss_fn(p, dtype):
+        return jnp.sum((x.astype(dtype) @ p["w"].astype(dtype))
+                       .astype(jnp.float32) ** 2)
+
+    opt = FP16_Optimizer(FusedSGD(lr=1e-3), static_loss_scale=128.0)
+    st = opt.init(model16)
+    ref = FusedSGD(lr=1e-3)
+    ref_p, ref_st = model32, ref.init(model32)
+    for _ in range(3):
+        g = jax.grad(lambda p: opt.scale_loss(
+            loss_fn(p, jnp.float16), st))(model16)
+        assert g["w"].dtype == jnp.float16
+        model16, st = opt.step(g, model16, st)
+        ref_g = jax.grad(lambda p: loss_fn(p, jnp.float32))(ref_p)
+        ref_p, ref_st = ref.step(ref_g, ref_p, ref_st)
+    np.testing.assert_allclose(np.asarray(st.master["w"]),
+                               np.asarray(ref_p["w"]), rtol=2e-2,
+                               atol=2e-3)
+    assert model16["w"].dtype == jnp.float16
+
+
+def test_fp16_optimizer_dynamic_overflow_skips_and_halves():
+    model16 = {"w": jnp.ones((4, 4), jnp.float16)}
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True)
+    st = opt.init(model16)
+    s0 = float(opt.loss_scale(st))
+    bad = {"w": jnp.full((4, 4), jnp.inf, jnp.float16)}
+    new_model, st = opt.step(bad, model16, st)
+    assert float(opt.loss_scale(st)) == s0 / 2
+    np.testing.assert_array_equal(np.asarray(new_model["w"]),
+                                  np.asarray(model16["w"]))
+    good = {"w": jnp.full((4, 4), 0.1, jnp.float16)}
+    new_model, st = opt.step(good, model16, st)
+    assert float(jnp.max(jnp.abs(new_model["w"] - model16["w"]))) > 0
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    model16 = {"w": jnp.ones((4, 4), jnp.float16)}
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True)
+    st = opt.init(model16)
+    st2 = opt.load_state_dict(opt.state_dict(st))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), st, st2)
+
+
+# -- mlp / fused_dense ------------------------------------------------------
+
+@pytest.mark.parametrize("activation", ["relu", "sigmoid", "none"])
+def test_mlp_matches_manual_chain(activation):
+    mlp = MLP([16, 32, 8], activation=activation)
+    params = mlp.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    want = x
+    for p in params:
+        want = want @ p["kernel"] + p["bias"]
+        if activation == "relu":
+            want = jax.nn.relu(want)
+        elif activation == "sigmoid":
+            want = jax.nn.sigmoid(want)
+    np.testing.assert_allclose(np.asarray(mlp.apply(params, x)),
+                               np.asarray(want), rtol=1e-6)
+
+
+def test_mlp_remat_same_values_and_grads():
+    mlp = MLP([16, 32, 8], remat=False)
+    mlp_r = MLP([16, 32, 8], remat=True)
+    params = mlp.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    f = lambda m: jax.grad(  # noqa: E731
+        lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6),
+        f(mlp), f(mlp_r))
+
+
+def test_mlp_validation():
+    with pytest.raises(ValueError):
+        MLP([16])
+    with pytest.raises(ValueError):
+        MLP([16, 8], activation="tanh")
+
+
+def test_fused_dense():
+    fd = FusedDense(16, 8)
+    p = fd.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    np.testing.assert_allclose(
+        np.asarray(fd.apply(p, x)),
+        np.asarray(x @ p["kernel"] + p["bias"]), rtol=1e-6)
+
+
+def test_fused_dense_gelu_dense():
+    fdg = FusedDenseGeluDense(16, 32, 8)
+    p = fdg.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    h = jax.nn.gelu(x @ p["fc1"]["kernel"] + p["fc1"]["bias"],
+                    approximate=False)
+    want = h @ p["fc2"]["kernel"] + p["fc2"]["bias"]
+    np.testing.assert_allclose(np.asarray(fdg.apply(p, x)),
+                               np.asarray(want), rtol=1e-6)
+
+
+def test_autocast_flows_through_mlp_and_fused_dense():
+    from apex_tpu.amp.autocast import autocast
+
+    mlp = MLP([16, 8])
+    fd = FusedDense(16, 8)
+    pm, pf = mlp.init(jax.random.PRNGKey(0)), fd.init(jax.random.PRNGKey(1))
+    x = jnp.ones((2, 16), jnp.float32)
+    with autocast(jnp.bfloat16):
+        assert mlp.apply(pm, x).dtype == jnp.bfloat16
+        assert fd.apply(pf, x).dtype == jnp.bfloat16
+    assert mlp.apply(pm, x).dtype == jnp.float32
