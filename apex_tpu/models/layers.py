@@ -86,19 +86,26 @@ def init_batchnorm(ch: int) -> Tuple[dict, dict]:
     return params, state
 
 
-def batchnorm(params: dict, state: dict, x: jax.Array, *, train: bool,
+def batchnorm(params: Optional[dict], state: Optional[dict],
+              x: jax.Array, *, train: bool,
               momentum: float = 0.9, eps: float = 1e-5,
               axis_name: Optional[str] = None
-              ) -> Tuple[jax.Array, dict]:
+              ) -> Tuple[jax.Array, Optional[dict]]:
     """BatchNorm over all but the channel (last) axis.
 
     ``axis_name``: when set and running inside shard_map/pmap, batch
     statistics are averaged across that mesh axis — this is the SyncBN hook
     used by ``apex_tpu.parallel.SyncBatchNorm`` (ref:
     ``apex/parallel/sync_batchnorm.py``).
+
+    ``params=None`` skips the affine transform (``affine=False``);
+    ``state=None`` means no running stats are tracked — batch statistics
+    are used even when ``train=False`` (torch's
+    ``track_running_stats=False`` semantics).
     """
     x32 = x.astype(jnp.float32)
-    if train:
+    use_batch_stats = train or state is None
+    if use_batch_stats:
         axes = tuple(range(x.ndim - 1))
         mean = jnp.mean(x32, axis=axes)
         mean_sq = jnp.mean(jnp.square(x32), axis=axes)
@@ -106,19 +113,23 @@ def batchnorm(params: dict, state: dict, x: jax.Array, *, train: bool,
             mean = lax.pmean(mean, axis_name)
             mean_sq = lax.pmean(mean_sq, axis_name)
         var = mean_sq - jnp.square(mean)
-        n = x32.size // x32.shape[-1]
-        if axis_name is not None:
-            n = n * lax.psum(1, axis_name)
-        unbiased = var * (n / max(n - 1, 1))
-        new_state = {
-            "mean": momentum * state["mean"] + (1 - momentum) * mean,
-            "var": momentum * state["var"] + (1 - momentum) * unbiased,
-        }
+        if train and state is not None:
+            n = x32.size // x32.shape[-1]
+            if axis_name is not None:
+                n = n * lax.psum(1, axis_name)
+            unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "mean": momentum * state["mean"] + (1 - momentum) * mean,
+                "var": momentum * state["var"] + (1 - momentum) * unbiased,
+            }
+        else:
+            new_state = state
     else:
         mean, var = state["mean"], state["var"]
         new_state = state
     y = (x32 - mean) * lax.rsqrt(var + eps)
-    y = y * params["scale"] + params["bias"]
+    if params is not None:
+        y = y * params["scale"] + params["bias"]
     return y.astype(x.dtype), new_state
 
 

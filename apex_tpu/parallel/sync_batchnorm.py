@@ -14,6 +14,7 @@ reference does in a single-process run.
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from apex_tpu.models import layers as L
 from apex_tpu.transformer import parallel_state as ps
@@ -36,25 +37,40 @@ class SyncBatchNorm:
         # default 0.1): running = (1 - momentum) * running + momentum * batch.
         # layers.batchnorm takes the keep fraction, so it receives
         # ``1 - momentum``.
-        if not channel_last:
-            raise NotImplementedError(
-                "TPU layout is NHWC/channel-last; transpose inputs instead")
-        if not affine or not track_running_stats:
-            raise NotImplementedError(
-                "affine=False / track_running_stats=False not supported yet")
         self.num_features = num_features
         self.eps = eps
         self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.channel_last = channel_last
         self.axis_name = axis_name if axis_name is not None else ps.DATA_AXIS
 
-    def init(self) -> Tuple[Dict, Dict]:
-        return L.init_batchnorm(self.num_features)
+    def init(self) -> Tuple[Optional[Dict], Optional[Dict]]:
+        """(params, running_state); ``affine=False`` → params None,
+        ``track_running_stats=False`` → state None (batch stats are then
+        used in eval too — torch semantics)."""
+        params, state = L.init_batchnorm(self.num_features)
+        return (params if self.affine else None,
+                state if self.track_running_stats else None)
 
-    def apply(self, params: Dict, state: Dict, x: jax.Array, *,
-              train: bool = True) -> Tuple[jax.Array, Dict]:
-        return L.batchnorm(params, state, x, train=train,
-                           momentum=1.0 - self.momentum, eps=self.eps,
-                           axis_name=self.axis_name if train else None)
+    def apply(self, params: Optional[Dict], state: Optional[Dict],
+              x: jax.Array, *, train: bool = True
+              ) -> Tuple[jax.Array, Optional[Dict]]:
+        if not self.channel_last:
+            # NCHW (torch layout): normalize over all but axis 1. A
+            # transpose pair is free here — XLA fuses layout changes into
+            # the surrounding reduction/elementwise ops.
+            x = jnp.moveaxis(x, 1, -1)
+        # stats sync also when eval-ing with batch stats (no running
+        # stats tracked) — every replica must normalize identically
+        use_batch = train or state is None
+        y, new_state = L.batchnorm(
+            params, state, x, train=train,
+            momentum=1.0 - self.momentum, eps=self.eps,
+            axis_name=self.axis_name if use_batch else None)
+        if not self.channel_last:
+            y = jnp.moveaxis(y, -1, 1)
+        return y, new_state
 
     __call__ = apply
 
