@@ -25,6 +25,9 @@ sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 from apex_tpu import amp  # noqa: E402
 from apex_tpu.models import apply_resnet, cross_entropy_loss, init_resnet  # noqa: E402
 from apex_tpu.optimizers import FusedSGD  # noqa: E402
+from apex_tpu.utils.checkpoint import (  # noqa: E402
+    load_checkpoint, save_checkpoint,
+)
 from apex_tpu.utils.metrics import AverageMeter, Throughput  # noqa: E402
 
 
@@ -45,6 +48,14 @@ def parse_args():
     p.add_argument("--keep-batchnorm-fp32", default=None)
     p.add_argument("--loss-scale", default=None)
     p.add_argument("--seed", type=int, default=0)
+    # checkpoint/resume (ref: main_amp.py --resume loading model+optimizer
+    # +amp.state_dict; here one atomic file holds the whole train state)
+    p.add_argument("--checkpoint", default=None,
+                   help="path to write checkpoints to")
+    p.add_argument("--save-freq", type=int, default=0,
+                   help="save every N steps (0: only at the end)")
+    p.add_argument("--resume", default=None,
+                   help="checkpoint path to resume from")
     return p.parse_args()
 
 
@@ -66,6 +77,24 @@ def main():
                    weight_decay=args.weight_decay)
     opt_state = opt.init(params)
     scaler_state = h.init_state()
+    start_step = 0
+    if args.resume:
+        ck = load_checkpoint(args.resume)
+        params, bn_stats = ck["params"], ck["bn_stats"]
+        opt_state = jax.tree.map(lambda ref, a: jnp.asarray(a),
+                                 opt_state, ck["opt_state"])
+        scaler_state = jax.tree.map(lambda ref, a: jnp.asarray(a),
+                                    scaler_state, ck["scaler_state"])
+        start_step = int(ck["step"]) + 1
+        print(f"resumed from {args.resume} at step {start_step}",
+              flush=True)
+
+    def save(step):
+        if not args.checkpoint:
+            return
+        save_checkpoint(args.checkpoint, {
+            "step": step, "params": params, "bn_stats": bn_stats,
+            "opt_state": opt_state, "scaler_state": scaler_state})
 
     def loss_fn(p, stats, images, labels):
         logits, new_stats = apply_resnet(p, stats, images, depth, train=True)
@@ -96,11 +125,15 @@ def main():
 
     losses = AverageMeter("Loss", ":.4e")
     speed = Throughput()
-    for i in range(args.steps):
+    if start_step >= args.steps:
+        print(f"nothing to do: resumed step {start_step} >= --steps "
+              f"{args.steps}")
+        return
+    for i in range(start_step, args.steps):
         images, labels = batch(i)
         params, bn_stats, opt_state, scaler_state, loss = train_step(
             params, bn_stats, opt_state, scaler_state, images, labels)
-        if i == 0:
+        if i == start_step:
             jax.block_until_ready(loss)
             speed.start()
             t0 = time.perf_counter()
@@ -108,13 +141,17 @@ def main():
             speed.tick(args.batch_size)
         if i % args.print_freq == 0 or i == args.steps - 1:
             losses.update(float(loss))
-            print(f"step {i:4d}  loss {losses}  "
+            print(f"step {i:4d}  loss {losses.val:.6f}  "
                   f"speed {speed.per_sec:8.1f} img/s", flush=True)
+        if args.save_freq and (i + 1) % args.save_freq == 0:
+            save(i)
     jax.block_until_ready(loss)
+    save(args.steps - 1)
     dt = time.perf_counter() - t0
-    n = (args.steps - 1) * args.batch_size
-    print(f"FINAL speed {n / dt:.1f} img/s  "
-          f"step_time {1000 * dt / max(args.steps - 1, 1):.2f} ms")
+    done = args.steps - start_step
+    n = (done - 1) * args.batch_size
+    print(f"FINAL speed {n / max(dt, 1e-9):.1f} img/s  "
+          f"step_time {1000 * dt / max(done - 1, 1):.2f} ms")
 
 
 if __name__ == "__main__":
