@@ -169,13 +169,16 @@ def apply_bert(params: Dict[str, Any], cfg: BertConfig,
     x = _maybe_dropout(x, cfg.hidden_dropout, rngs[0])
 
     for li, layer in enumerate(params["encoder"]):
-        att = _attention(layer["attention"], cfg, x, attention_mask,
-                         rngs[2 * li + 1])
-        att = _maybe_dropout(att, cfg.hidden_dropout, rngs[2 * li + 2])
-        x = _ln(layer["attention"]["layernorm"], x + att, cfg.layer_norm_eps)
-        mlp = L.dense(layer["mlp"]["fc2"],
-                      jax.nn.gelu(L.dense(layer["mlp"]["fc1"], x)))
-        x = _ln(layer["mlp"]["layernorm"], x + mlp, cfg.layer_norm_eps)
+        with jax.named_scope(f"layer{li}/attention"):
+            att = _attention(layer["attention"], cfg, x, attention_mask,
+                             rngs[2 * li + 1])
+            att = _maybe_dropout(att, cfg.hidden_dropout, rngs[2 * li + 2])
+            x = _ln(layer["attention"]["layernorm"], x + att,
+                    cfg.layer_norm_eps)
+        with jax.named_scope(f"layer{li}/mlp"):
+            mlp = L.dense(layer["mlp"]["fc2"],
+                          jax.nn.gelu(L.dense(layer["mlp"]["fc1"], x)))
+            x = _ln(layer["mlp"]["layernorm"], x + mlp, cfg.layer_norm_eps)
 
     head = params["mlm_head"]
     t = jax.nn.gelu(L.dense(head["transform"], x))

@@ -184,15 +184,17 @@ def _causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
 def _block(lp, x, cfg, rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
            dropout_rng=None):
     """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
-    att = _causal_attention(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
-                                                  cfg.layer_norm_eps)),
-                            cfg, rope_freqs)
-    att = out_fn(lp["out"], att)
-    att = _maybe_dropout(att, cfg.hidden_dropout, dropout_rng, 0)
-    x = x + att
-    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
-        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
-    mlp = _maybe_dropout(mlp, cfg.hidden_dropout, dropout_rng, 1)
+    with jax.named_scope("attention"):
+        att = _causal_attention(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
+                                                      cfg.layer_norm_eps)),
+                                cfg, rope_freqs)
+        att = out_fn(lp["out"], att)
+        att = _maybe_dropout(att, cfg.hidden_dropout, dropout_rng, 0)
+        x = x + att
+    with jax.named_scope("mlp"):
+        mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+            fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+        mlp = _maybe_dropout(mlp, cfg.hidden_dropout, dropout_rng, 1)
     return x + mlp
 
 

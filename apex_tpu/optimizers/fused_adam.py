@@ -86,12 +86,13 @@ class FusedAdam:
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1
 
-        if self.use_flat_kernel:
-            new_params, new_state = self._flat_step(
-                grads, params, state, lr, wd, t, grad_scale)
-        else:
-            new_params, new_state = self._tree_step(
-                grads, params, state, lr, wd, t, grad_scale)
+        with jax.named_scope("FusedAdam.step"):
+            if self.use_flat_kernel:
+                new_params, new_state = self._flat_step(
+                    grads, params, state, lr, wd, t, grad_scale)
+            else:
+                new_params, new_state = self._tree_step(
+                    grads, params, state, lr, wd, t, grad_scale)
 
         # On overflow the reference skips optimizer.step() entirely, so
         # params AND optimizer state (including the step count) stay put.

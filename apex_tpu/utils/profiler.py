@@ -1,0 +1,39 @@
+"""Profiling hooks (SURVEY §5: tracing/profiling subsystem).
+
+The reference leans on ``pyprof``/nvprof markers (removed upstream) and
+``torch.cuda.nvtx`` ranges. The TPU-native story is XLA's own tracer:
+
+- :func:`trace` wraps ``jax.profiler.trace`` — writes a TensorBoard-
+  loadable trace (``tensorboard --logdir <dir>``, "Profile" tab, or
+  ``xprof``). Device-side timelines come from XLA itself; nothing to
+  instrument.
+- :func:`annotate` (= ``jax.named_scope``) is the nvtx-range analogue:
+  regions named here appear on the trace's Python/HLO-metadata rows, and
+  the scope names survive into HLO op metadata so device kernels
+  attribute back to model regions. The in-tree models and fused
+  optimizers are pre-annotated (attention / mlp / optimizer scopes).
+
+Typical use::
+
+    from apex_tpu.utils.profiler import annotate, trace
+    with trace("/tmp/tb"):
+        for _ in range(3):
+            state = train_step(state)   # named scopes inside
+"""
+
+import contextlib
+
+import jax
+
+annotate = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False):
+    """Capture a device+host profile under ``log_dir``."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
