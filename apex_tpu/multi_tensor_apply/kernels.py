@@ -196,6 +196,168 @@ def _adam_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
     v_out[:] = v
 
 
+def _sgd_kernel(sc_ref, g_ref, p_ref, buf_ref, p_out, buf_out):
+    lr = sc_ref[0, 0]
+    mom = sc_ref[0, 1]
+    damp = sc_ref[0, 2]
+    wd = sc_ref[0, 3]
+    nesterov = sc_ref[0, 4]        # 1.0 / 0.0
+    wd_after = sc_ref[0, 5]        # 1.0 => wd after momentum
+    first = sc_ref[0, 6]           # 1.0 on the seeding step
+    grad_scale = sc_ref[0, 7]
+    use_mom = sc_ref[0, 8]         # momentum > 0
+
+    g = g_ref[:].astype(jnp.float32) * grad_scale
+    p = p_ref[:]
+    buf = buf_ref[:]
+
+    g = g + (1.0 - wd_after) * wd * p
+    seeded = jnp.where(first > 0, g, mom * buf + (1.0 - damp) * g)
+    d_mom = jnp.where(nesterov > 0, g + mom * seeded, seeded)
+    d = jnp.where(use_mom > 0, d_mom, g)
+    buf_out[:] = jnp.where(use_mom > 0, seeded, buf)
+    d = d + wd_after * wd * p
+    p_out[:] = p - lr * d
+
+
+def flat_sgd(grads: jax.Array, params: jax.Array, momentum_buf: jax.Array,
+             *, lr, momentum: float, dampening: float, weight_decay,
+             nesterov: bool, wd_after_momentum: bool, first_run,
+             grad_scale=1.0, interpret: Optional[bool] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """One fused SGD step over flat fp32 buffers (ref:
+    ``csrc/multi_tensor_sgd_kernel.cu`` incl. the ``first_run`` buffer
+    seeding and ``wd_after_momentum``). ``params``/``momentum_buf`` alias
+    in place; ``first_run`` may be a traced bool."""
+    rows = params.shape[0]
+    gp, pp, bp = (_pad_to_block(b) for b in (grads, params, momentum_buf))
+    n_tiles = pp.shape[0] // BLOCK_ROWS
+    sc = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(momentum),
+        jnp.float32(dampening), jnp.asarray(weight_decay, jnp.float32),
+        jnp.float32(1.0 if nesterov else 0.0),
+        jnp.float32(1.0 if wd_after_momentum else 0.0),
+        jnp.asarray(first_run, jnp.float32),
+        jnp.asarray(grad_scale, jnp.float32),
+        jnp.float32(1.0 if momentum > 0 else 0.0),
+    ]).reshape(1, 9)
+    p_new, b_new = pl.pallas_call(
+        _sgd_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec()] + [_tile_spec()] * 3,
+        out_specs=[_tile_spec()] * 2,
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        input_output_aliases={2: 0, 3: 1},
+        interpret=pallas_interpret(interpret),
+    )(sc, gp, pp, bp)
+    return p_new[:rows], b_new[:rows]
+
+
+# ---------------------------------------------------------------------------
+# LAMB — ref csrc/multi_tensor_lamb.cu (_stage_1 + _stage_2)
+# ---------------------------------------------------------------------------
+
+def _lamb_stage1_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
+                        m_out, v_out, u_out, p_ssq, u_ssq):
+    b1 = sc_ref[0, 0]
+    b2 = sc_ref[0, 1]
+    eps = sc_ref[0, 2]
+    wd = sc_ref[0, 3]
+    c1 = sc_ref[0, 4]
+    c2 = sc_ref[0, 5]
+    adam_w = sc_ref[0, 6]
+    beta3 = sc_ref[0, 7]          # 1-b1 (grad averaging) or 1.0
+    gs_over_clip = sc_ref[0, 8]   # grad_scale / clip, combined
+
+    g = g_ref[:].astype(jnp.float32) * gs_over_clip
+    p = p_ref[:]
+    m = m_ref[:]
+    v = v_ref[:]
+
+    g_l2 = g + (1.0 - adam_w) * wd * p
+    m = b1 * m + beta3 * g_l2
+    v = b2 * v + (1.0 - b2) * g_l2 * g_l2
+    u = (m / c1) / (jnp.sqrt(v / c2) + eps) + adam_w * wd * p
+    m_out[:] = m
+    v_out[:] = v
+    u_out[:] = u
+    # fused stage-2 preamble: per-(8,128)-sub-tile ||p||², ||u||² partials
+    # (tensor spans are 8-row aligned, so each partial maps to one tensor)
+    p_ssq[0, :] = jnp.sum((p * p).reshape(_SUBS_PER_BLOCK, _SUB * LANES), 1)
+    u_ssq[0, :] = jnp.sum((u * u).reshape(_SUBS_PER_BLOCK, _SUB * LANES), 1)
+
+
+def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
+              v: jax.Array, tile_ids, *, lr, beta1: float, beta2: float,
+              eps: float, step, weight_decay, num_tensors: int,
+              adam_w_mode: bool = True, grad_averaging: bool = True,
+              bias_correction: bool = True, use_nvlamb: bool = False,
+              max_grad_norm: float = 1.0, grad_scale=1.0,
+              grad_norm=None, interpret: Optional[bool] = None
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused LAMB step over flat fp32 buffers, following the CUDA
+    two-stage split: stage 1 (one Pallas pass) produces moments, the raw
+    update AND the per-sub-tile ||p||²/||u||² partials; the per-tensor
+    trust-ratio combine (segment-sum + ratio) and the stage-2
+    ``p -= lr·ratio·u`` are XLA elementwise/reduction ops that fuse into
+    two trivial passes. ``tile_ids`` is ``FlatSpec.tile_tensor_ids(8)``.
+    The global grad-norm clip uses one ``flat_l2norm`` pre-pass over the
+    scaled grads (the reference likewise pre-reduces)."""
+    rows = params.shape[0]
+    gs = jnp.asarray(grad_scale, jnp.float32)
+    if grad_norm is None:
+        grad_norm = jnp.sqrt(jnp.sum(
+            flat_l2norm_partials(grads, interpret)) * gs * gs)
+    max_norm = jnp.float32(max_grad_norm)
+    clip = jnp.where((max_norm > 0) & (grad_norm > max_norm),
+                     grad_norm / max_norm, jnp.float32(1.0))
+
+    gp, pp, mp, vp = (_pad_to_block(b) for b in (grads, params, m, v))
+    n_tiles = pp.shape[0] // BLOCK_ROWS
+    t = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        c1 = 1.0 - jnp.float32(beta1) ** t
+        c2 = 1.0 - jnp.float32(beta2) ** t
+    else:
+        c1 = c2 = jnp.float32(1.0)
+    sc = jnp.stack([
+        jnp.float32(beta1), jnp.float32(beta2), jnp.float32(eps),
+        jnp.asarray(weight_decay, jnp.float32), c1, c2,
+        jnp.float32(1.0 if adam_w_mode else 0.0),
+        jnp.float32(1.0 - beta1 if grad_averaging else 1.0),
+        gs / clip,
+    ]).reshape(1, 9)
+    part_spec = pl.BlockSpec((1, _SUBS_PER_BLOCK), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    m_new, v_new, u, p_parts, u_parts = pl.pallas_call(
+        _lamb_stage1_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec()] + [_tile_spec()] * 4,
+        out_specs=[_tile_spec()] * 3 + [part_spec] * 2,
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3
+        + [jax.ShapeDtypeStruct((n_tiles, _SUBS_PER_BLOCK), jnp.float32)] * 2,
+        input_output_aliases={3: 0, 4: 1},
+        interpret=pallas_interpret(interpret),
+    )(sc, gp, pp, mp, vp)
+
+    # stage 2: per-tensor trust ratios from the fused partials
+    ids = jnp.asarray(tile_ids, jnp.int32)
+    n_sub = rows // _SUB
+    w_norm = jnp.sqrt(jax.ops.segment_sum(
+        p_parts.reshape(-1)[:n_sub], ids, num_segments=num_tensors))
+    u_norm = jnp.sqrt(jax.ops.segment_sum(
+        u_parts.reshape(-1)[:n_sub], ids, num_segments=num_tensors))
+    ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
+                      jnp.float32(1.0))
+    if not use_nvlamb:
+        wd_t = jnp.asarray(weight_decay, jnp.float32)
+        ratio = jnp.where(wd_t == 0.0, jnp.ones_like(ratio), ratio)
+    row_ratio = jnp.repeat(ratio[ids], _SUB)[:, None]  # (rows, 1)
+    lr_t = jnp.asarray(lr, jnp.float32)
+    p_new = pp[:rows] - lr_t * row_ratio * u[:rows]
+    return p_new, m_new[:rows], v_new[:rows]
+
+
 def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
               *, lr, beta1: float, beta2: float, eps: float, step,
               weight_decay, adam_w_mode: bool = True,

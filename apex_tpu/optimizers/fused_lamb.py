@@ -33,7 +33,8 @@ class FusedLAMB:
                  weight_decay: float = 0.01, amsgrad: bool = False,
                  adam_w_mode: bool = True, grad_averaging: bool = True,
                  max_grad_norm: float = 1.0,
-                 use_nvlamb: bool = False):
+                 use_nvlamb: bool = False, *,
+                 use_flat_kernel: bool = False):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         self.lr = lr
@@ -46,9 +47,32 @@ class FusedLAMB:
         self.max_grad_norm = max_grad_norm
         # NVLAMB: apply the trust ratio even to tensors with no weight decay
         self.use_nvlamb = use_nvlamb
+        self.use_flat_kernel = use_flat_kernel
+        self._specs = {}
+
+    def _layout(self, params):
+        from apex_tpu.multi_tensor_apply import flatten as _flatten
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef,
+               tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
+        cached = self._specs.get(key)
+        if cached is None:
+            spec = _flatten.make_spec(leaves)
+            cached = self._specs[key] = (spec, spec.tile_tensor_ids(8))
+        return leaves, treedef, cached[0], cached[1]
 
     def init(self, params: Any) -> LambState:
-        return LambState(step=jnp.zeros((), jnp.int32),
+        step = jnp.zeros((), jnp.int32)
+        if self.use_flat_kernel:
+            from apex_tpu.multi_tensor_apply import flatten as _flatten
+
+            leaves, _, spec, _ = self._layout(params)
+            buf, _ = _flatten.flatten_tensors(leaves, spec,
+                                              dtype=jnp.float32)
+            return LambState(step=step, m=jnp.zeros_like(buf),
+                             v=jnp.zeros_like(buf))
+        return LambState(step=step,
                          m=tree_zeros_f32(params), v=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: LambState, *,
@@ -71,6 +95,31 @@ class FusedLAMB:
             c2 = 1.0 - b2 ** tf
         else:
             c1 = c2 = jnp.float32(1.0)
+
+        if self.use_flat_kernel:
+            from apex_tpu.multi_tensor_apply import flatten as _flatten
+            from apex_tpu.multi_tensor_apply.kernels import flat_lamb
+
+            leaves, treedef, spec, tile_ids = self._layout(params)
+            gbuf, _ = _flatten.flatten_tensors(
+                jax.tree_util.tree_leaves(grads), spec)
+            pbuf, _ = _flatten.flatten_tensors(leaves, spec)
+            p_new, m_new, v_new = flat_lamb(
+                gbuf, pbuf, state.m, state.v, tile_ids,
+                lr=lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                step=t, weight_decay=wd, num_tensors=spec.num_tensors,
+                adam_w_mode=self.adam_w_mode,
+                grad_averaging=self.grad_averaging,
+                bias_correction=self.bias_correction,
+                use_nvlamb=self.use_nvlamb,
+                max_grad_norm=self.max_grad_norm, grad_scale=gs,
+                grad_norm=grad_norm)
+            new_params = jax.tree_util.tree_unflatten(
+                treedef, _flatten.unflatten_tensors(p_new, spec))
+            new_state = LambState(step=t, m=m_new, v=v_new)
+            new_params = select_finite(found_inf, new_params, params)
+            new_state = select_finite(found_inf, new_state, state)
+            return new_params, new_state
 
         # stage 1 preamble: global-norm grad clipping
         if grad_norm is None:

@@ -24,7 +24,8 @@ class FusedSGD:
     def __init__(self, lr: float, momentum: float = 0.0,
                  dampening: float = 0.0, weight_decay: float = 0.0,
                  nesterov: bool = False, *,
-                 wd_after_momentum: bool = False):
+                 wd_after_momentum: bool = False,
+                 use_flat_kernel: bool = False):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
@@ -34,10 +35,30 @@ class FusedSGD:
         self.weight_decay = weight_decay
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
+        self.use_flat_kernel = use_flat_kernel
+        self._specs = {}
+
+    def _layout(self, params):
+        from apex_tpu.multi_tensor_apply import flatten as _flatten
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef,
+               tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
+        spec = self._specs.get(key)
+        if spec is None:
+            spec = self._specs[key] = _flatten.make_spec(leaves)
+        return leaves, treedef, spec
 
     def init(self, params: Any) -> SGDState:
-        return SGDState(step=jnp.zeros((), jnp.int32),
-                        momentum_buf=tree_zeros_f32(params))
+        step = jnp.zeros((), jnp.int32)
+        if self.use_flat_kernel:
+            from apex_tpu.multi_tensor_apply import flatten as _flatten
+
+            leaves, _, spec = self._layout(params)
+            buf, _ = _flatten.flatten_tensors(leaves, spec,
+                                              dtype=jnp.float32)
+            return SGDState(step=step, momentum_buf=jnp.zeros_like(buf))
+        return SGDState(step=step, momentum_buf=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: SGDState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
@@ -52,6 +73,27 @@ class FusedSGD:
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1
         first = (state.step == 0)
+
+        if self.use_flat_kernel:
+            from apex_tpu.multi_tensor_apply import flatten as _flatten
+            from apex_tpu.multi_tensor_apply.kernels import flat_sgd
+
+            leaves, treedef, spec = self._layout(params)
+            gbuf, _ = _flatten.flatten_tensors(
+                jax.tree_util.tree_leaves(grads), spec)
+            pbuf, _ = _flatten.flatten_tensors(leaves, spec)
+            p_new, b_new = flat_sgd(
+                gbuf, pbuf, state.momentum_buf, lr=lr,
+                momentum=self.momentum, dampening=self.dampening,
+                weight_decay=wd, nesterov=self.nesterov,
+                wd_after_momentum=self.wd_after_momentum,
+                first_run=first, grad_scale=gs)
+            new_params = jax.tree_util.tree_unflatten(
+                treedef, _flatten.unflatten_tensors(p_new, spec))
+            new_state = SGDState(step=t, momentum_buf=b_new)
+            new_params = select_finite(found_inf, new_params, params)
+            new_state = select_finite(found_inf, new_state, state)
+            return new_params, new_state
 
         def upd(g, p, buf):
             g = g.astype(jnp.float32) * gs

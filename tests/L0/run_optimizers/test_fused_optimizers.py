@@ -81,6 +81,34 @@ def test_fused_adam_flat_kernel_matches_tree_path():
     assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("kw", [
+    dict(momentum=0.9, weight_decay=1e-4),
+    dict(momentum=0.9, nesterov=True),
+    dict(momentum=0.9, weight_decay=1e-4, wd_after_momentum=True),
+    dict(),  # plain SGD, no momentum
+])
+def test_fused_sgd_flat_kernel_matches_tree_path(kw):
+    params = make_params(jax.random.PRNGKey(3))
+    got, _ = run_steps(FusedSGD(lr=1e-2, use_flat_kernel=True, **kw),
+                       params)
+    want, _ = run_steps(FusedSGD(lr=1e-2, **kw), params)
+    assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(weight_decay=0.01),
+    dict(weight_decay=0.01, adam_w_mode=False),
+    dict(weight_decay=0.0, use_nvlamb=True),
+    dict(weight_decay=0.01, max_grad_norm=0.05),  # clip engages
+])
+def test_fused_lamb_flat_kernel_matches_tree_path(kw):
+    params = make_params(jax.random.PRNGKey(4))
+    got, _ = run_steps(FusedLAMB(lr=1e-2, use_flat_kernel=True, **kw),
+                       params)
+    want, _ = run_steps(FusedLAMB(lr=1e-2, **kw), params)
+    assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_fused_adam_skips_on_overflow():
     params = make_params(jax.random.PRNGKey(3))
     opt = FusedAdam(lr=1e-2)

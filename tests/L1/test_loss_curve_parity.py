@@ -1,0 +1,108 @@
+"""L1 convergence tier: multi-step loss-curve parity (SURVEY §4/§7 —
+the reference's L1 ``cross_product`` suite trains fp16 vs fp32 pairs and
+compares loss curves per step; the north star's "loss parity" clause).
+
+The reference publishes no numbers (BASELINE.md), so the golden curve is
+the package's own fp32 (O0) run: every amp level must track it within
+mixed-precision tolerance step by step, and training must actually
+converge (final < initial)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.models import (
+    apply_bert, bert_tiny, gpt_loss_unsharded, gpt_tiny, init_bert,
+    init_gpt, mlm_loss,
+)
+from apex_tpu.optimizers import FusedAdam
+
+STEPS = 20
+
+
+def bert_curve(opt_level, loss_scale="dynamic", seed=0):
+    """Loss curve of a full amp train loop on deterministic data."""
+    cfg = bert_tiny()
+    h = amp.initialize(opt_level=opt_level, loss_scale=loss_scale,
+                       verbosity=0)
+    params = init_bert(jax.random.PRNGKey(seed), cfg)
+    opt = FusedAdam(lr=5e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    scaler_state = h.init_state()
+
+    def batch(i):
+        k = jax.random.PRNGKey(10_000 + i)
+        ids = jax.random.randint(k, (4, 32), 0, cfg.vocab_size)
+        return ids, jnp.ones_like(ids)
+
+    @jax.jit
+    def step(master, opt_state, scaler_state, ids, mask):
+        p = h.cast_model(master)
+
+        def loss_fn(p):
+            out = apply_bert(p, cfg, ids, mask)
+            return mlm_loss(out["mlm_logits"], ids, mask)
+
+        with h.autocast():
+            loss, grads, found_inf, scaler_state = h.value_and_grad(
+                loss_fn)(p, scaler_state)
+        master, opt_state = opt.step(grads, master, opt_state,
+                                     found_inf=found_inf)
+        return master, opt_state, scaler_state, loss
+
+    losses = []
+    for i in range(STEPS):
+        ids, mask = batch(i)
+        params, opt_state, scaler_state, loss = step(
+            params, opt_state, scaler_state, ids, mask)
+        losses.append(float(loss))
+    return np.array(losses)
+
+
+@pytest.fixture(scope="module")
+def golden_curve():
+    return bert_curve("O0", loss_scale=1.0)
+
+
+def test_golden_run_converges(golden_curve):
+    assert np.all(np.isfinite(golden_curve))
+    assert golden_curve[-1] < golden_curve[0] - 0.1, golden_curve
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_amp_curve_tracks_fp32(golden_curve, opt_level):
+    """Per-step parity: |amp - fp32| relative error bounded along the
+    WHOLE curve (bf16 matmul noise compounds; 5% absorbs it at toy
+    scale), and the amp run converges on its own."""
+    curve = bert_curve(opt_level)
+    assert np.all(np.isfinite(curve))
+    np.testing.assert_allclose(curve, golden_curve, rtol=0.05)
+    assert curve[-1] < curve[0] - 0.1
+    # the curves must NOT be identical — proof reduced precision ran
+    assert np.any(curve != golden_curve)
+
+
+def test_gpt_converges():
+    cfg = gpt_tiny()
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    opt = FusedAdam(lr=1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss_unsharded(p, cfg, ids, ids))(params)
+        params, opt_state = opt.step(grads, params, opt_state)
+        return params, opt_state, loss
+
+    # overfit ONE fixed batch — the unambiguous convergence smoke
+    ids = jax.random.randint(jax.random.PRNGKey(20_000), (4, 32), 0,
+                             cfg.vocab_size)
+    losses = []
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, ids)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses
