@@ -1,0 +1,6 @@
+"""Peer-to-peer halo exchange (ref: ``apex/contrib/peer_memory``)."""
+
+from apex_tpu.contrib.peer_memory.halo_exchange import (  # noqa: F401
+    PeerHaloExchanger1d,
+    halo_exchange_1d,
+)

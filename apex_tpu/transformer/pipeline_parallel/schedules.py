@@ -118,9 +118,20 @@ def forward_backward_no_pipelining(
     num_microbatches: Optional[int] = None,
     forward_only: bool = False,
     checkpoint_stages: bool = True,
+    fp32_grad_accum: bool = True,
 ) -> Tuple[jax.Array, Optional[Pytree]]:
     """Grad accumulation over microbatches, no pipe collectives
-    (ref: ``fwd_bwd_no_pipelining.py``). Usable with or without a mesh."""
+    (ref: ``fwd_bwd_no_pipelining.py``). Usable with or without a mesh.
+
+    ``fp32_grad_accum`` is the ``gradient_accumulation_fusion`` analogue
+    (ref: ``fused_weight_gradient_mlp_cuda`` writing wgrads straight into
+    fp32 ``main_grad`` buffers): the accumulator tree is fp32 regardless
+    of param/compute dtype, so M bf16 microbatch grads don't lose low
+    bits as they sum, and the fp32 result feeds the optimizer directly
+    (every ``apex_tpu`` optimizer consumes fp32 grads natively — the
+    TPU "fusion" is that XLA folds the widening cast into the bwd GEMM's
+    epilogue rather than a separate kernel).
+    """
     M = _num_microbatches(num_microbatches)
     mbs = split_batch_into_microbatches(batch, M)
     stage = _stage_apply(model, checkpoint_stages)
@@ -137,15 +148,19 @@ def forward_backward_no_pipelining(
         return total / M, None
 
     vg = jax.value_and_grad(mb_loss)
+    acc_dtype = (lambda a: jnp.promote_types(a.dtype, jnp.float32)) \
+        if fp32_grad_accum else (lambda a: a.dtype)
 
     def step(carry, mb):
         tot, g = carry
         loss, gi = vg(params, mb)
-        return (tot + loss, jax.tree.map(jnp.add, g, gi)), None
+        g = jax.tree.map(lambda a, b: a + b.astype(a.dtype), g, gi)
+        return (tot + loss, g), None
 
-    zero_g = jax.tree.map(jnp.zeros_like, params)
+    zero_g = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, acc_dtype(a)), params)
     (total, grads), _ = lax.scan(step, (zero, zero_g), mbs)
-    grads = jax.tree.map(lambda a: (a / M).astype(a.dtype), grads)
+    grads = jax.tree.map(lambda a: a / M, grads)
     return total / M, grads
 
 

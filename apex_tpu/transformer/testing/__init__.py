@@ -1,0 +1,35 @@
+"""Transformer test rig (ref: ``apex/transformer/testing``).
+
+The reference keeps Megatron-shaped test infrastructure here:
+``standalone_bert.py``/``standalone_gpt.py`` (in-tree models exercising
+the TP/PP stack), ``global_vars.py``/``arguments.py`` (the Megatron flag
+system the schedules consult), and ``commons.py`` (distributed-test
+helpers). The TPU equivalents:
+
+- the standalone models live in the first-class zoo (``apex_tpu.models``:
+  BERT and the TP/PP-ready GPT) — re-exported here under the reference
+  names so reference-shaped test code finds them;
+- ``global_vars``/``arguments`` are real (Megatron-style argparse +
+  process-global args registry) for scripts written against that API.
+"""
+
+from apex_tpu.models.bert import (  # noqa: F401  (standalone_bert)
+    BertConfig,
+    apply_bert,
+    bert_tiny,
+    init_bert,
+)
+from apex_tpu.models.gpt import (  # noqa: F401  (standalone_gpt)
+    GPTConfig,
+    GPTModel,
+    gpt_pipeline_model,
+    gpt_tiny,
+    init_gpt,
+)
+from apex_tpu.transformer.testing.arguments import (  # noqa: F401
+    parse_args,
+)
+from apex_tpu.transformer.testing.global_vars import (  # noqa: F401
+    get_args,
+    set_args,
+)

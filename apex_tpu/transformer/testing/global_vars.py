@@ -1,0 +1,32 @@
+"""Process-global args registry (ref:
+``apex/transformer/testing/global_vars.py :: get_args/set_global_variables``
+— Megatron keeps parsed flags in a module global that schedules and test
+helpers read). Functional JAX code should thread config explicitly; this
+exists for reference-shaped scripts."""
+
+from typing import Optional
+
+_GLOBAL_ARGS = None
+
+
+def set_args(args) -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args():
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError(
+            "global args not initialized — call set_args(parse_args()) "
+            "first (ref: Megatron's set_global_variables)")
+    return _GLOBAL_ARGS
+
+
+def unset_args() -> None:
+    """Test teardown helper."""
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = None
+
+
+def args_are_set() -> bool:
+    return _GLOBAL_ARGS is not None

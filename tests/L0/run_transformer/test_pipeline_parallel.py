@@ -232,3 +232,37 @@ def test_lone_send_recv_fail_fast():
                p2p.send_backward, p2p.recv_backward):
         with pytest.raises(RuntimeError, match="single collective"):
             fn(jnp.ones(4))
+
+
+def test_fp32_grad_accumulation_beats_bf16():
+    """The gradient_accumulation_fusion analogue (ref:
+    fused_weight_gradient_mlp_cuda): bf16 microbatch grads summed in an
+    fp32 main-grad accumulator keep low bits a bf16 accumulator drops.
+    Grad w.r.t. head = mb value; [256, 1, 1, ...] makes bf16 addition
+    round every +1 away (bf16 ulp at 256 is 2)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        PipelineModel, forward_backward_no_pipelining,
+    )
+
+    model = PipelineModel(
+        embed_fn=lambda e, mb: mb.astype(jnp.bfloat16),
+        stage_fn=lambda sp, h: h + 0.0 * sp["w"].astype(h.dtype),
+        loss_fn=lambda head, x, mb: jnp.sum(
+            head["w"] * x).astype(jnp.float32),
+    )
+    params = {"embed": {}, "stages": {"w": jnp.ones((1, 1), jnp.bfloat16)},
+              "head": {"w": jnp.ones((1,), jnp.bfloat16)}}
+    batch = jnp.concatenate([jnp.array([256.0], jnp.float32),
+                             jnp.ones((7,), jnp.float32)])
+
+    _, g32 = jax.jit(lambda p: forward_backward_no_pipelining(
+        model, p, batch, num_microbatches=8, checkpoint_stages=False))(
+        params)
+    _, gb16 = jax.jit(lambda p: forward_backward_no_pipelining(
+        model, p, batch, num_microbatches=8, checkpoint_stages=False,
+        fp32_grad_accum=False))(params)
+    assert g32["head"]["w"].dtype == jnp.float32
+    assert gb16["head"]["w"].dtype == jnp.bfloat16
+    # exact mean: (256 + 7) / 8 = 32.875; bf16 accumulation loses the +1s
+    np.testing.assert_allclose(float(g32["head"]["w"][0]), 32.875)
+    assert float(gb16["head"]["w"][0]) == 32.0

@@ -1,0 +1,113 @@
+"""Ring-attention (context parallel) parity on an 8-way context mesh:
+the sequence-sharded ring must reproduce full flash/softmax attention
+bit-closely, forward AND gradients, incl. causal and padding masks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.context_parallel import ring_attention
+from apex_tpu.transformer.functional import flash_attention
+
+CP = 8
+B, H, S, D = 2, 4, 64, 16  # S_local = 8 per rank
+
+
+def cp_mesh():
+    return ps.initialize_model_parallel(context_parallel_size_=CP)
+
+
+def data(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D))
+    k = jax.random.normal(ks[1], (B, H, S, D))
+    v = jax.random.normal(ks[2], (B, H, S, D))
+    return q, k, v
+
+
+SEQ_SHARDED = P(None, None, ps.CONTEXT_AXIS, None)
+
+
+def run_ring(q, k, v, mask=None, **kw):
+    mesh = cp_mesh()
+    if mask is None:
+        f = lambda q, k, v: ring_attention(q, k, v, **kw)  # noqa: E731
+        return ps.shard_map(
+            f, in_specs=(SEQ_SHARDED,) * 3, out_specs=SEQ_SHARDED)(q, k, v)
+    f = lambda q, k, v, m: ring_attention(q, k, v, m, **kw)  # noqa: E731
+    return ps.shard_map(
+        f, in_specs=(SEQ_SHARDED,) * 3 + (P(None, ps.CONTEXT_AXIS),),
+        out_specs=SEQ_SHARDED)(q, k, v, mask)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_flash_attention(causal):
+    q, k, v = data()
+    got = run_ring(q, k, v, causal=causal)
+    want = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_padding_mask():
+    q, k, v = data(1)
+    mask = jnp.ones((B, S), jnp.int32).at[:, S // 3:].set(0)
+    got = run_ring(q, k, v, mask)
+    want = flash_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fully_masked_rows_return_zero():
+    """Causal + padding can fully mask early rows on later ranks' qs?
+    Simplest total check: all-zero mask ⇒ all-zero output (the flash
+    convention), no NaNs from the ring merge."""
+    q, k, v = data(2)
+    got = run_ring(q, k, v, jnp.zeros((B, S), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+@pytest.mark.parametrize("checkpoint_blocks", [False, True])
+def test_gradients_match_full_attention(checkpoint_blocks):
+    q, k, v = data(3)
+    mesh = cp_mesh()
+
+    def ring_loss(q, k, v):
+        out = ring_attention(q, k, v, causal=True,
+                             checkpoint_blocks=checkpoint_blocks)
+        return jnp.sum(out ** 2, dtype=jnp.float32)
+
+    # sum over seq-sharded outputs: sum local partials then psum
+    def local(q, k, v):
+        val, grads = jax.value_and_grad(ring_loss, argnums=(0, 1, 2))(
+            q, k, v)
+        return jax.lax.psum(val, ps.CONTEXT_AXIS), grads
+
+    got_loss, got_grads = jax.jit(ps.shard_map(
+        local, in_specs=(SEQ_SHARDED,) * 3,
+        out_specs=(P(), (SEQ_SHARDED,) * 3)))(q, k, v)
+
+    want_loss, want_grads = jax.value_and_grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True) ** 2, dtype=jnp.float32),
+        argnums=(0, 1, 2))(q, k, v)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5)
+    for g, w in zip(got_grads, want_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_cp1_degenerates_to_flash():
+    ps.initialize_model_parallel(context_parallel_size_=1)
+    q, k, v = data(4)
+    got = ps.shard_map(
+        lambda q, k, v: ring_attention(q, k, v),
+        in_specs=(P(),) * 3, out_specs=P())(q, k, v)
+    want = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)

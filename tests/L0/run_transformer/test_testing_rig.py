@@ -1,0 +1,45 @@
+"""transformer/testing tier (ref: ``apex/transformer/testing`` —
+arguments/global_vars + standalone model re-exports)."""
+
+import pytest
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer import testing as T
+from apex_tpu.transformer.testing import arguments, global_vars
+
+
+def test_parse_args_defaults_and_flags():
+    ns = arguments.parse_args(args=[
+        "--tensor-model-parallel-size", "2",
+        "--pipeline-model-parallel-size", "4",
+        "--num-layers", "8", "--bf16",
+        "--some-unknown-megatron-flag", "x"])  # tolerated
+    assert ns.tensor_model_parallel_size == 2
+    assert ns.pipeline_model_parallel_size == 4
+    assert ns.num_layers == 8 and ns.bf16 and not ns.fp16
+
+
+def test_global_vars_registry():
+    global_vars.unset_args()
+    with pytest.raises(RuntimeError, match="set_args"):
+        global_vars.get_args()
+    ns = arguments.parse_args(args=[])
+    global_vars.set_args(ns)
+    assert global_vars.get_args() is ns
+    assert global_vars.args_are_set()
+    global_vars.unset_args()
+
+
+def test_initialize_from_args_builds_mesh():
+    ns = arguments.parse_args(args=[
+        "--tensor-model-parallel-size", "2",
+        "--pipeline-model-parallel-size", "2"])
+    mesh = arguments.initialize_from_args(ns)
+    assert dict(mesh.shape)[ps.TENSOR_AXIS] == 2
+    assert dict(mesh.shape)[ps.PIPE_AXIS] == 2
+
+
+def test_standalone_reexports():
+    # reference-shaped imports resolve to the first-class zoo
+    assert T.init_bert is not None and T.init_gpt is not None
+    assert T.GPTModel is not None and T.bert_tiny().num_layers == 2
