@@ -1,0 +1,14 @@
+"""Recurrent cells and stacks (ref: ``apex/RNN``)."""
+
+from apex_tpu.RNN.cells import (  # noqa: F401
+    gru_cell,
+    init_gru_cell,
+    init_lstm_cell,
+    init_mlstm_cell,
+    init_rnn_cell,
+    lstm_cell,
+    mlstm_cell,
+    rnn_relu_cell,
+    rnn_tanh_cell,
+)
+from apex_tpu.RNN.models import GRU, LSTM, RNN, mLSTM  # noqa: F401

@@ -1,0 +1,134 @@
+"""Functional RNN cells (ref: ``apex/RNN/cells.py`` — the fp16-era
+``mLSTMRNNCell``/``mLSTMCell`` plus the torch builtins the backend wraps).
+
+The reference tier exists to make recurrent cells fp16-safe; it is
+deprecated upstream but still in-tree, so the surface is reproduced.
+TPU design: cells are pure step functions ``(params, x_t, state) ->
+state`` driven by ``lax.scan`` in :mod:`apex_tpu.RNN.models` — the
+recurrence compiles to one fused loop, and the gate matmuls are packed
+(one (in+hidden, 4·hidden) GEMM per step) to feed the MXU. Gate math is
+fp32 regardless of storage dtype (the tier's original purpose).
+"""
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+def _uniform(key, shape, bound, dtype):
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def _init_gates(key, input_size, hidden_size, n_gates, dtype, bias=True):
+    """Packed torch-style init: U(-1/sqrt(H), 1/sqrt(H))."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    bound = 1.0 / math.sqrt(hidden_size)
+    p = {
+        "w_ih": _uniform(k1, (input_size, n_gates * hidden_size), bound,
+                         dtype),
+        "w_hh": _uniform(k2, (hidden_size, n_gates * hidden_size), bound,
+                         dtype),
+    }
+    if bias:
+        p["b_ih"] = _uniform(k3, (n_gates * hidden_size,), bound, dtype)
+        p["b_hh"] = _uniform(k4, (n_gates * hidden_size,), bound, dtype)
+    return p
+
+
+def _gates(p: Params, x, h):
+    g = jnp.dot(x, p["w_ih"].astype(x.dtype)) \
+        + jnp.dot(h, p["w_hh"].astype(h.dtype))
+    if "b_ih" in p:
+        g = g + p["b_ih"].astype(g.dtype) + p["b_hh"].astype(g.dtype)
+    return g.astype(jnp.float32)
+
+
+# -- LSTM -------------------------------------------------------------------
+
+def init_lstm_cell(key, input_size: int, hidden_size: int,
+                   dtype=jnp.float32, bias: bool = True) -> Params:
+    return _init_gates(key, input_size, hidden_size, 4, dtype, bias)
+
+
+def lstm_cell(p: Params, x: jax.Array,
+              state: Tuple[jax.Array, jax.Array]
+              ) -> Tuple[jax.Array, jax.Array]:
+    """(h, c) -> (h', c'); torch gate order i, f, g, o."""
+    h, c = state
+    i, f, g, o = jnp.split(_gates(p, x, h), 4, axis=-1)
+    c32 = c.astype(jnp.float32)
+    c_new = jax.nn.sigmoid(f) * c32 + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new.astype(h.dtype), c_new.astype(c.dtype)
+
+
+# -- mLSTM (multiplicative LSTM, the reference's own cell) ------------------
+
+def init_mlstm_cell(key, input_size: int, hidden_size: int,
+                    dtype=jnp.float32, bias: bool = True) -> Params:
+    k1, k2 = jax.random.split(key)
+    p = _init_gates(k1, input_size, hidden_size, 4, dtype, bias)
+    bound = 1.0 / math.sqrt(hidden_size)
+    km1, km2 = jax.random.split(k2)
+    p["w_mih"] = _uniform(km1, (input_size, hidden_size), bound, dtype)
+    p["w_mhh"] = _uniform(km2, (hidden_size, hidden_size), bound, dtype)
+    return p
+
+
+def mlstm_cell(p: Params, x: jax.Array,
+               state: Tuple[jax.Array, jax.Array]
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Krause et al. multiplicative LSTM (ref ``mLSTMCell``): the hidden
+    state is replaced by m = (x Wmx) ⊙ (h Wmh) before the LSTM gates."""
+    h, c = state
+    m = (jnp.dot(x, p["w_mih"].astype(x.dtype))
+         * jnp.dot(h, p["w_mhh"].astype(h.dtype)))
+    i, f, g, o = jnp.split(_gates(p, x, m), 4, axis=-1)
+    c32 = c.astype(jnp.float32)
+    c_new = jax.nn.sigmoid(f) * c32 + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new.astype(h.dtype), c_new.astype(c.dtype)
+
+
+# -- GRU --------------------------------------------------------------------
+
+def init_gru_cell(key, input_size: int, hidden_size: int,
+                  dtype=jnp.float32, bias: bool = True) -> Params:
+    return _init_gates(key, input_size, hidden_size, 3, dtype, bias)
+
+
+def gru_cell(p: Params, x: jax.Array, state: jax.Array) -> jax.Array:
+    """torch GRU: r, z from packed gates; n mixes b_ih/b_hh asymmetrically."""
+    h = state
+    gi = jnp.dot(x, p["w_ih"].astype(x.dtype))
+    gh = jnp.dot(h, p["w_hh"].astype(h.dtype))
+    if "b_ih" in p:
+        gi = gi + p["b_ih"].astype(gi.dtype)
+        gh = gh + p["b_hh"].astype(gh.dtype)
+    gi, gh = gi.astype(jnp.float32), gh.astype(jnp.float32)
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    h_new = (1.0 - z) * n + z * h.astype(jnp.float32)
+    return h_new.astype(h.dtype)
+
+
+# -- vanilla RNN ------------------------------------------------------------
+
+def init_rnn_cell(key, input_size: int, hidden_size: int,
+                  dtype=jnp.float32, bias: bool = True) -> Params:
+    return _init_gates(key, input_size, hidden_size, 1, dtype, bias)
+
+
+def rnn_tanh_cell(p: Params, x: jax.Array, state: jax.Array) -> jax.Array:
+    return jnp.tanh(_gates(p, x, state)).astype(state.dtype)
+
+
+def rnn_relu_cell(p: Params, x: jax.Array, state: jax.Array) -> jax.Array:
+    return jax.nn.relu(_gates(p, x, state)).astype(state.dtype)

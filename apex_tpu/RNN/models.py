@@ -1,0 +1,120 @@
+"""Stacked/bidirectional recurrent models (ref: ``apex/RNN/models.py`` +
+``RNNBackend.py`` — ``LSTM``/``GRU``/``RNNReLU``/``RNNTanh``/``mLSTM``
+builders over ``stackedRNN``/``bidirectionalRNN`` wrappers).
+
+The time loop is ONE ``lax.scan`` per layer-direction (fused XLA while
+loop — the fp16-era per-step Python loop the reference wraps simply does
+not exist here); layers stack sequentially, the bidirectional variant
+runs a reversed scan and concatenates features, and inter-layer dropout
+matches torch semantics (not after the last layer).
+"""
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.RNN import cells as C
+
+_CELLS = {
+    "lstm": (C.init_lstm_cell, C.lstm_cell, True),
+    "mlstm": (C.init_mlstm_cell, C.mlstm_cell, True),
+    "gru": (C.init_gru_cell, C.gru_cell, False),
+    "rnn_tanh": (C.init_rnn_cell, C.rnn_tanh_cell, False),
+    "rnn_relu": (C.init_rnn_cell, C.rnn_relu_cell, False),
+}
+
+
+class RNN:
+    """``RNN(mode, input_size, hidden_size, num_layers, ...)``; apply on
+    (seq, batch, input) returns (seq, batch, D·hidden) plus final states
+    (D = 2 if bidirectional)."""
+
+    def __init__(self, mode: str, input_size: int, hidden_size: int,
+                 num_layers: int = 1, *, bias: bool = True,
+                 dropout: float = 0.0, bidirectional: bool = False,
+                 params_dtype=jnp.float32):
+        if mode not in _CELLS:
+            raise ValueError(f"mode must be one of {sorted(_CELLS)}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+        self.params_dtype = params_dtype
+        self.init_cell, self.cell, self.has_cell_state = _CELLS[mode]
+
+    def init(self, key: jax.Array) -> List[Dict[str, Any]]:
+        d = 2 if self.bidirectional else 1
+        layers = []
+        keys = jax.random.split(key, self.num_layers * d)
+        for li in range(self.num_layers):
+            in_sz = self.input_size if li == 0 else self.hidden_size * d
+            layer = {"fwd": self.init_cell(keys[li * d], in_sz,
+                                           self.hidden_size,
+                                           self.params_dtype, self.bias)}
+            if self.bidirectional:
+                layer["bwd"] = self.init_cell(keys[li * d + 1], in_sz,
+                                              self.hidden_size,
+                                              self.params_dtype, self.bias)
+            layers.append(layer)
+        return layers
+
+    def _zero_state(self, batch: int, dtype):
+        h = jnp.zeros((batch, self.hidden_size), dtype)
+        return (h, jnp.zeros_like(h)) if self.has_cell_state else h
+
+    def _run_direction(self, p, xs, reverse: bool):
+        if reverse:
+            xs = jnp.flip(xs, axis=0)
+        state0 = self._zero_state(xs.shape[1], xs.dtype)
+
+        def step(state, x):
+            new = self.cell(p, x, state)
+            h = new[0] if self.has_cell_state else new
+            return new, h
+
+        final, hs = lax.scan(step, state0, xs)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        return hs, final
+
+    def apply(self, params: List[Dict[str, Any]], xs: jax.Array, *,
+              dropout_rng: Optional[jax.Array] = None
+              ) -> Tuple[jax.Array, List[Any]]:
+        finals = []
+        for li, layer in enumerate(params):
+            hs_f, fin_f = self._run_direction(layer["fwd"], xs, False)
+            if self.bidirectional:
+                hs_b, fin_b = self._run_direction(layer["bwd"], xs, True)
+                xs = jnp.concatenate([hs_f, hs_b], axis=-1)
+                finals.append((fin_f, fin_b))
+            else:
+                xs = hs_f
+                finals.append(fin_f)
+            if (dropout_rng is not None and self.dropout > 0
+                    and li < self.num_layers - 1):
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(dropout_rng, li),
+                    1 - self.dropout, xs.shape)
+                xs = xs * keep / (1 - self.dropout)
+        return xs, finals
+
+    __call__ = apply
+
+
+LSTM = functools.partial(RNN, "lstm")
+mLSTM = functools.partial(RNN, "mlstm")
+GRU = functools.partial(RNN, "gru")
+
+
+def RNNReLU(*args, **kw):
+    return RNN("rnn_relu", *args, **kw)
+
+
+def RNNTanh(*args, **kw):
+    return RNN("rnn_tanh", *args, **kw)

@@ -1,0 +1,7 @@
+"""Weight-norm reparameterization (ref: ``apex/reparameterization``)."""
+
+from apex_tpu.reparameterization.weight_norm import (  # noqa: F401
+    apply_weight_norm,
+    compute_weight,
+    remove_weight_norm,
+)

@@ -1,0 +1,50 @@
+"""Weight normalization — w = g · v/‖v‖ (Salimans & Kingma 2016).
+
+Reference: ``apex/reparameterization/weight_norm.py`` +
+``reparameterization.py`` (module hooks splitting a weight into
+magnitude ``g`` and direction ``v``, with an fp16-safe fused norm). The
+reference marks this tier deprecated; kept for API completeness.
+
+Functional translation: a pytree transform pair instead of module hooks.
+``apply_weight_norm`` splits selected leaves into ``{"g", "v"}`` dicts;
+``compute_weight`` reconstitutes w (differentiable — grads flow to g and
+v exactly as the reference's autograd does); ``remove_weight_norm``
+re-fuses. The norm is taken over all but ``dim`` (reference default
+dim=0), computed in fp32 regardless of storage dtype (the fp16-safety
+that motivated apex's version).
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _norm_keep(v: jax.Array, dim: int) -> jax.Array:
+    axes = tuple(i for i in range(v.ndim) if i != dim % max(v.ndim, 1))
+    v32 = v.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(v32 * v32, axis=axes, keepdims=True))
+
+
+def apply_weight_norm(weight: jax.Array, dim: int = 0
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Split w -> (g, v) with w == g · v/‖v‖ initially (v = w,
+    g = ‖w‖ over all axes but ``dim``)."""
+    g = _norm_keep(weight, dim).astype(weight.dtype)
+    return g, weight
+
+
+def compute_weight(g: jax.Array, v: jax.Array, dim: int = 0) -> jax.Array:
+    """w = g · v/‖v‖, norm in fp32 (the reference kernel's fp16-safe
+    promotion), result in v's dtype."""
+    norm = _norm_keep(v, dim)
+    w = v.astype(jnp.float32) / jnp.maximum(norm, 1e-12) \
+        * g.astype(jnp.float32)
+    return w.astype(v.dtype)
+
+
+def remove_weight_norm(g: jax.Array, v: jax.Array,
+                       dim: int = 0) -> jax.Array:
+    """Fuse (g, v) back into a plain weight (ref:
+    ``remove_weight_norm``)."""
+    return compute_weight(g, v, dim)

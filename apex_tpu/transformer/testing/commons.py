@@ -1,0 +1,45 @@
+"""Shared test-rig helpers (ref: ``apex/transformer/testing/commons.py``
+— ``initialize_distributed``, ``set_random_seed``, model builders the
+reference's transformer tests share).
+
+TPU translations: process-group bootstrap becomes mesh construction
+(single-controller; multi-host via ``jax.distributed``); torch's global
+RNG seeding becomes explicit key construction plus the TP RNG tracker."""
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+
+def initialize_distributed(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1,
+                           virtual_pipeline_model_parallel_size:
+                           Optional[int] = None,
+                           context_parallel_size: int = 1):
+    """Build the global mesh (ref: spawns/initializes the torch process
+    group then calls ``parallel_state.initialize_model_parallel``)."""
+    ps.destroy_model_parallel()
+    return ps.initialize_model_parallel(
+        tensor_model_parallel_size_=tensor_model_parallel_size,
+        pipeline_model_parallel_size_=pipeline_model_parallel_size,
+        virtual_pipeline_model_parallel_size_=(
+            virtual_pipeline_model_parallel_size),
+        context_parallel_size_=context_parallel_size)
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed the TP RNG tracker and return a fresh root key (ref: seeds
+    python/numpy/torch globals + the cuda-rng tracker; JAX has no global
+    RNG — the returned key is the explicit equivalent)."""
+    tracker = tp_random.get_rng_tracker()
+    tracker.reset()
+    tracker.add("model-parallel-rng", seed + 2718)
+    return jax.random.PRNGKey(seed)
+
+
+def print_separator(message: str) -> None:
+    """The reference's test-section banner."""
+    print("\n" + "-" * 20 + f" {message} " + "-" * 20, flush=True)

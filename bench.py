@@ -21,6 +21,7 @@ under ``"parsed"``) when present, else null (the reference publishes no
 numbers — BASELINE.md).
 """
 
+import functools
 import glob
 import json
 import os
@@ -125,8 +126,11 @@ def bench_layer_norm(on_tpu):
                 x, w, b, h, 1e-5).astype(jnp.float32) ** 2))(x)
             return g.astype(jnp.bfloat16)
 
+        # M sized so the 4M-iteration delta (~0.1 ms/iter · 1600) is far
+        # above the axon relay's ~±20 ms dispatch noise; M=50 measured
+        # 0.0 for h=1024 (delta inside noise)
         dt = timed(body, x, lambda s: jnp.sum(s.astype(jnp.float32)),
-                   M=50 if on_tpu else 2)
+                   M=400 if on_tpu else 2)
         # bytes: read x (fwd) + read x,dy (bwd) + write y, dx ~ 5 * 2B
         gbps = 5 * rows * h * 2 / dt / 1e9
         emit(f"fused_layer_norm_fwdbwd_h{h}", dt * 1e6, "us/iter",
@@ -136,18 +140,55 @@ def bench_layer_norm(on_tpu):
 
 # -- config 3: optimizer step on BERT-Large param set -----------------------
 
-def bench_optimizers(on_tpu):
-    from apex_tpu.models import bert_large, bert_tiny, init_bert
+def _make_optimizer(which):
     from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+    return {
+        "adam": lambda: FusedAdam(lr=1e-4, weight_decay=0.01),
+        "adam_flat": lambda: FusedAdam(lr=1e-4, weight_decay=0.01,
+                                       use_flat_kernel=True),
+        "lamb": lambda: FusedLAMB(lr=1e-3, weight_decay=0.01),
+    }[which]()
+
+
+def bench_one_optimizer(which, on_tpu):
+    """One optimizer per subprocess: BERT-Large fp32 state doesn't fit
+    twice in HBM (measured ResourceExhausted when chained in-process)."""
+    from apex_tpu.models import bert_large, bert_tiny, init_bert
 
     cfg = bert_large() if on_tpu else bert_tiny()
     params = init_bert(jax.random.PRNGKey(0), cfg)
     grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), params)
-    for name, opt in (("fused_adam", FusedAdam(lr=1e-4, weight_decay=0.01)),
-                      ("fused_adam_flat",
-                       FusedAdam(lr=1e-4, weight_decay=0.01,
-                                 use_flat_kernel=True)),
-                      ("fused_lamb", FusedLAMB(lr=1e-3, weight_decay=0.01))):
+    opt = _make_optimizer(which)
+    opt_state = opt.init(params)
+
+    def body(state):
+        p, s = state
+        return opt.step(grads, p, s)
+
+    dt = timed(body, (params, opt_state),
+               lambda s: jnp.sum(s[0]["pooler"]["bias"]),
+               M=10 if on_tpu else 2)
+    emit(f"fused_{which}_step_bert_large_params", dt * 1e3, "ms/step",
+         higher_is_better=False)
+
+
+def bench_flat_vs_tree_many_tensors(on_tpu):
+    """The flat path's actual claim (fused_adam docstring): it pays off
+    when per-leaf overhead dominates — a 1024-small-tensor param set
+    (the BERT-Large set is 400 LARGE tensors, where the tree path's XLA
+    fusion already wins and the flat round-trip can't fit in HBM)."""
+    from apex_tpu.optimizers import FusedAdam
+
+    n = 1024 if on_tpu else 32
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    params = {f"t{i}": jax.random.normal(k, (64, 128)) for i, k in
+              enumerate(keys)}
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), params)
+    for name, opt in (
+            ("tree", FusedAdam(lr=1e-4, weight_decay=0.01)),
+            ("flat", FusedAdam(lr=1e-4, weight_decay=0.01,
+                               use_flat_kernel=True))):
         opt_state = opt.init(params)
 
         def body(state, opt=opt):
@@ -155,9 +196,8 @@ def bench_optimizers(on_tpu):
             return opt.step(grads, p, s)
 
         dt = timed(body, (params, opt_state),
-                   lambda s: jnp.sum(s[0]["pooler"]["bias"]),
-                   M=10 if on_tpu else 2)
-        emit(f"{name}_step_bert_large_params", dt * 1e3, "ms/step",
+                   lambda s: jnp.sum(s[0]["t0"]), M=20 if on_tpu else 2)
+        emit(f"fused_adam_{name}_{n}_small_tensors", dt * 1e3, "ms/step",
              higher_is_better=False)
 
 
@@ -267,7 +307,9 @@ def bench_headline(on_tpu):
 
 CONFIGS = {
     "layer_norm": bench_layer_norm,
-    "optimizers": bench_optimizers,
+    "opt_adam": functools.partial(bench_one_optimizer, "adam"),
+    "opt_lamb": functools.partial(bench_one_optimizer, "lamb"),
+    "opt_flat_vs_tree": bench_flat_vs_tree_many_tensors,
     "ddp_bert": bench_ddp_bert,
     "tp_gpt": bench_tp_gpt,
     "headline": bench_headline,

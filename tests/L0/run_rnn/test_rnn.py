@@ -1,0 +1,119 @@
+"""RNN cell/stack tests (ref: ``apex/RNN`` — the deprecated fp16 RNN
+tier; golden comparisons against hand-rolled steps and torch-semantics
+checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.RNN import (
+    GRU, LSTM, RNN, gru_cell, init_gru_cell, init_lstm_cell,
+    init_mlstm_cell, lstm_cell, mlstm_cell,
+)
+
+S, B, I, H = 6, 2, 5, 4
+
+
+def test_lstm_cell_matches_manual():
+    p = init_lstm_cell(jax.random.PRNGKey(0), I, H)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, I))
+    h = jax.random.normal(jax.random.PRNGKey(2), (B, H))
+    c = jax.random.normal(jax.random.PRNGKey(3), (B, H))
+    h2, c2 = lstm_cell(p, x, (h, c))
+
+    g = x @ p["w_ih"] + h @ p["w_hh"] + p["b_ih"] + p["b_hh"]
+    i_, f, g_, o = np.split(np.asarray(g), 4, axis=-1)
+    sig = lambda z: 1 / (1 + np.exp(-z))  # noqa: E731
+    c_want = sig(f) * np.asarray(c) + sig(i_) * np.tanh(g_)
+    h_want = sig(o) * np.tanh(c_want)
+    np.testing.assert_allclose(np.asarray(h2), h_want, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c2), c_want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gru_cell_matches_manual():
+    p = init_gru_cell(jax.random.PRNGKey(0), I, H)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, I))
+    h = jax.random.normal(jax.random.PRNGKey(2), (B, H))
+    h2 = gru_cell(p, x, h)
+
+    gi = np.asarray(x @ p["w_ih"] + p["b_ih"])
+    gh = np.asarray(h @ p["w_hh"] + p["b_hh"])
+    i_r, i_z, i_n = np.split(gi, 3, -1)
+    h_r, h_z, h_n = np.split(gh, 3, -1)
+    sig = lambda z: 1 / (1 + np.exp(-z))  # noqa: E731
+    r, z = sig(i_r + h_r), sig(i_z + h_z)
+    n = np.tanh(i_n + r * h_n)
+    want = (1 - z) * n + z * np.asarray(h)
+    np.testing.assert_allclose(np.asarray(h2), want, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_stack_equals_unrolled_cells():
+    model = LSTM(I, H, num_layers=2)
+    params = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, I))
+    out, finals = model.apply(params, xs)
+    assert out.shape == (S, B, H) and len(finals) == 2
+
+    # unroll by hand through both layers
+    cur = np.asarray(xs)
+    for layer in params:
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        outs = []
+        for t in range(S):
+            h, c = lstm_cell(layer["fwd"], jnp.asarray(cur[t]),
+                             (jnp.asarray(h), jnp.asarray(c)))
+            h, c = np.asarray(h), np.asarray(c)
+            outs.append(h)
+        cur = np.stack(outs)
+    np.testing.assert_allclose(np.asarray(out), cur, rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_concat_and_reverse():
+    model = GRU(I, H, bidirectional=True)
+    params = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, I))
+    out, finals = model.apply(params, xs)
+    assert out.shape == (S, B, 2 * H)
+    # the backward half at time 0 is the bwd scan's LAST state
+    fin_f, fin_b = finals[0]
+    np.testing.assert_allclose(np.asarray(out[-1, :, :H]),
+                               np.asarray(fin_f), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0, :, H:]),
+                               np.asarray(fin_b), rtol=1e-6)
+
+
+def test_mlstm_runs_and_differs_from_lstm():
+    mp = init_mlstm_cell(jax.random.PRNGKey(0), I, H)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, I))
+    h = jax.random.normal(jax.random.PRNGKey(2), (B, H))
+    c = jnp.zeros((B, H))
+    h2, c2 = mlstm_cell(mp, x, (h, c))
+    assert h2.shape == (B, H)
+    lp = {k: mp[k] for k in ("w_ih", "w_hh", "b_ih", "b_hh")}
+    h3, _ = lstm_cell(lp, x, (h, c))
+    # nonzero h: the multiplicative m = (xWmx)⊙(hWmh) replaces h in the
+    # gates, so the two cells diverge (at h=0 both see zeros)
+    assert float(jnp.max(jnp.abs(h2 - h3))) > 0
+
+
+def test_gradients_flow_and_dtype_held():
+    model = LSTM(I, H, num_layers=2, dropout=0.1)
+    params = model.init(jax.random.PRNGKey(0))
+    xs = jax.random.normal(jax.random.PRNGKey(1), (S, B, I),
+                           jnp.bfloat16)
+    out, _ = model.apply(params, xs.astype(jnp.bfloat16),
+                         dropout_rng=jax.random.PRNGKey(2))
+    assert out.dtype == jnp.bfloat16  # gate math fp32, output dtype held
+    g = jax.grad(lambda p: jnp.sum(
+        model.apply(p, xs)[0].astype(jnp.float32)))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+def test_mode_validation():
+    with pytest.raises(ValueError, match="mode"):
+        RNN("conv", I, H)
