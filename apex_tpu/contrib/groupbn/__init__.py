@@ -1,0 +1,3 @@
+"""Group BatchNorm (ref: ``apex/contrib/groupbn``)."""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC  # noqa: F401

@@ -1,0 +1,99 @@
+"""Group-synchronized NHWC BatchNorm with fused add+ReLU epilogue.
+
+Reference: ``apex/contrib/groupbn/batch_norm.py :: BatchNorm2d_NHWC``
+(CUDA in ``csrc/groupbn/*``) — the MLPerf ResNet block: NHWC batch norm
+whose statistics sync across a GROUP of ``bn_group`` GPUs (not the whole
+world), with the residual add and ReLU fused into the normalization
+kernel's epilogue.
+
+TPU mapping: group-limited stat sync is ``lax.pmean`` with
+``axis_index_groups`` partitioning the data axis into consecutive groups
+of ``bn_group`` ranks — XLA emits the reduced-scope allreduce over ICI
+exactly as the CUDA kernels run NCCL on a sub-communicator. The
+add+ReLU epilogue is ordinary code XLA fuses into the normalization's
+elementwise chain (the "let XLA fuse" rule); stats are fp32.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+class BatchNorm2d_NHWC:
+    """``init() -> (params, running_state)``; ``apply(params, state, x,
+    z=None, train=...) -> (y, new_state)``. ``bn_group=0`` syncs across
+    the WHOLE axis; ``bn_group=1`` is rank-local (the reference
+    default); ``k > 1`` syncs consecutive groups of k ranks."""
+
+    def __init__(self, num_features: int, *, fuse_relu: bool = False,
+                 bn_group: int = 1, momentum: float = 0.1,
+                 eps: float = 1e-5,
+                 axis_name: Optional[str] = None):
+        self.num_features = num_features
+        self.fuse_relu = fuse_relu
+        self.bn_group = bn_group
+        self.momentum = momentum
+        self.eps = eps
+        self.axis_name = axis_name if axis_name is not None else \
+            ps.DATA_AXIS
+
+    def init(self) -> Tuple[Dict, Dict]:
+        params = {"scale": jnp.ones((self.num_features,), jnp.float32),
+                  "bias": jnp.zeros((self.num_features,), jnp.float32)}
+        state = {"mean": jnp.zeros((self.num_features,), jnp.float32),
+                 "var": jnp.ones((self.num_features,), jnp.float32)}
+        return params, state
+
+    def _groups(self):
+        if self.bn_group == 1:
+            return None  # rank-local stats: no collective at all
+        n = lax.axis_size(self.axis_name)
+        k = n if self.bn_group == 0 else self.bn_group
+        if n % k:
+            raise ValueError(
+                f"bn_group {k} does not divide axis size {n}")
+        return [list(range(g * k, (g + 1) * k)) for g in range(n // k)]
+
+    def apply(self, params: Dict, state: Dict, x: jax.Array,
+              z: Optional[jax.Array] = None, *, train: bool = True
+              ) -> Tuple[jax.Array, Dict]:
+        x32 = x.astype(jnp.float32)
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x32, axis=axes)
+            mean_sq = jnp.mean(jnp.square(x32), axis=axes)
+            if self.bn_group != 1:
+                groups = self._groups()
+                mean = lax.pmean(mean, self.axis_name,
+                                 axis_index_groups=groups)
+                mean_sq = lax.pmean(mean_sq, self.axis_name,
+                                    axis_index_groups=groups)
+            var = mean_sq - jnp.square(mean)
+            n = x32.size // x32.shape[-1]
+            if self.bn_group != 1:
+                n = n * (lax.axis_size(self.axis_name)
+                         if self.bn_group == 0 else self.bn_group)
+            unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "mean": (1 - self.momentum) * state["mean"]
+                + self.momentum * mean,
+                "var": (1 - self.momentum) * state["var"]
+                + self.momentum * unbiased,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        y = (x32 - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["scale"] + params["bias"]
+        if z is not None:
+            # the fused add epilogue (reference: bn_add_relu kernel)
+            y = y + z.astype(jnp.float32)
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(x.dtype), new_state
+
+    __call__ = apply

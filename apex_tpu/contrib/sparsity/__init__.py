@@ -1,0 +1,8 @@
+"""Automatic structured sparsity (ref: ``apex/contrib/sparsity``)."""
+
+from apex_tpu.contrib.sparsity.asp import (  # noqa: F401
+    ASP,
+    apply_masks,
+    compute_sparse_masks,
+    m4n2_1d_mask,
+)

@@ -1,0 +1,94 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Reference: ``apex/contrib/sparsity/asp.py :: class ASP`` +
+``sparse_masklib.py`` (``m4n2_1d``: in every group of 4 consecutive
+weights along the input dim, keep the 2 largest magnitudes) — the
+Ampere sparse-tensor-core workflow: compute masks once on a trained
+model, hook the optimizer so masks re-apply after every step, fine-tune.
+
+TPU honesty note: TPUs have no 2:4 sparse MXU mode, so masking buys no
+FLOPs here — what this module preserves is the WORKFLOW (prune on TPU,
+deploy wherever, or study sparsified training). The mask math is
+identical; the optimizer hook becomes a functional wrapper
+(``ASP.wrap_optimizer``) because there is no mutable optimizer to hook.
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def m4n2_1d_mask(w: jax.Array) -> jax.Array:
+    """Boolean keep-mask: top-2-of-4 |w| along the LAST dim (ref:
+    ``mn_1d_best`` with m=4, n=2). Last dim must divide by 4."""
+    if w.shape[-1] % 4:
+        raise ValueError(
+            f"last dim {w.shape[-1]} not divisible by 4 (m4n2 pattern)")
+    groups = jnp.abs(w).reshape(*w.shape[:-1], w.shape[-1] // 4, 4)
+    # rank within each group; keep the two largest magnitudes
+    order = jnp.argsort(jnp.argsort(groups, axis=-1), axis=-1)
+    keep = order >= 2
+    return keep.reshape(w.shape)
+
+
+def _default_predicate(path: tuple, leaf: jax.Array) -> bool:
+    """Prunable = float matrices with a 4-divisible contraction dim and
+    both dims >= 16 (the reference skips embeddings/small/1-D params via
+    its whitelist; path is available for custom predicates)."""
+    return (leaf.ndim == 2 and leaf.shape[-1] % 4 == 0
+            and min(leaf.shape) >= 16
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def compute_sparse_masks(params: Any,
+                         predicate: Optional[Callable] = None) -> Any:
+    """Mask pytree: m4n2 masks for prunable leaves, all-True otherwise
+    (ref: ``ASP.compute_sparse_masks`` walking the module whitelist)."""
+    pred = predicate or _default_predicate
+
+    def mask_of(path, leaf):
+        if pred(path, leaf):
+            return m4n2_1d_mask(leaf)
+        return jnp.ones(leaf.shape, bool)
+
+    return jax.tree_util.tree_map_with_path(mask_of, params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    return jax.tree.map(
+        lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks)
+
+
+class ASP:
+    """Functional ASP workflow::
+
+        asp = ASP()
+        masks = asp.compute_sparse_masks(params)   # after pretraining
+        params = apply_masks(params, masks)
+        step = asp.wrap_optimizer(opt, masks)      # masked fine-tuning
+        params, opt_state = step(grads, params, opt_state)
+
+    (ref: ``init_model_for_pruning`` + ``init_optimizer_for_pruning`` +
+    ``compute_sparse_masks`` — the torch version monkey-patches
+    ``optimizer.step``; the wrapper is its functional twin.)"""
+
+    def __init__(self, predicate: Optional[Callable] = None):
+        self.predicate = predicate
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        return compute_sparse_masks(params, self.predicate)
+
+    def wrap_optimizer(self, optimizer, masks: Any):
+        """Returns a ``step(grads, params, state, **kw)`` that re-applies
+        the masks to the updated params (and masks the grads first, so
+        momentum never accumulates toward pruned slots)."""
+
+        def step(grads, params, state, **kw
+                 ) -> Tuple[Any, Any]:
+            grads = apply_masks(grads, masks)
+            new_params, new_state = optimizer.step(grads, params, state,
+                                                   **kw)
+            return apply_masks(new_params, masks), new_state
+
+        return step

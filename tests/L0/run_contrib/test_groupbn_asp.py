@@ -1,0 +1,121 @@
+"""GroupBN + ASP tests (ref: ``apex/contrib/test/{groupbn,sparsity}``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.sparsity import (
+    ASP,
+    apply_masks,
+    compute_sparse_masks,
+    m4n2_1d_mask,
+)
+from apex_tpu.models import layers as L
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.transformer import parallel_state as ps
+
+N = 8
+
+
+def dp_mesh():
+    return ps.initialize_model_parallel()
+
+
+# -- groupbn ---------------------------------------------------------------
+
+def test_bn_group_equals_subgroup_stats():
+    """bn_group=4: ranks 0-3 normalize with THEIR joint stats, 4-7 with
+    theirs — equal to plain BN over each gathered half-batch."""
+    mesh = dp_mesh()
+    bn = BatchNorm2d_NHWC(6, bn_group=4)
+    params, state = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 5, 5, 6)) * 2 + 1
+
+    y, _ = ps.shard_map(
+        lambda p, s, x: bn.apply(p, s, x, train=True),
+        in_specs=(P(), P(), P(ps.DATA_AXIS)),
+        out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+
+    bnp, bns = L.init_batchnorm(6)
+    y_ref = jnp.concatenate([
+        L.batchnorm(bnp, bns, x[:8], train=True, eps=1e-5)[0],
+        L.batchnorm(bnp, bns, x[8:], train=True, eps=1e-5)[0]])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bn_group_zero_syncs_whole_axis():
+    mesh = dp_mesh()
+    bn = BatchNorm2d_NHWC(4, bn_group=0)
+    params, state = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 4)) * 3 - 1
+    y, _ = ps.shard_map(
+        lambda p, s, x: bn.apply(p, s, x, train=True),
+        in_specs=(P(), P(), P(ps.DATA_AXIS)),
+        out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+    y = np.asarray(y)
+    np.testing.assert_allclose(y.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.var(0), 1.0, rtol=1e-3)
+
+
+def test_fused_add_relu_epilogue():
+    bn = BatchNorm2d_NHWC(4, fuse_relu=True)  # bn_group=1: local, no mesh
+    params, state = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
+    z = jax.random.normal(jax.random.PRNGKey(3), (32, 4))
+    y, _ = bn.apply(params, state, x, z, train=True)
+    yn, _ = BatchNorm2d_NHWC(4).apply(params, state, x, train=True)
+    want = np.maximum(np.asarray(yn) + np.asarray(z), 0.0)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5, atol=1e-6)
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_bn_group_divisibility_error():
+    mesh = dp_mesh()
+    bn = BatchNorm2d_NHWC(4, bn_group=3)
+    params, state = bn.init()
+    x = jnp.ones((16, 4))
+    with pytest.raises(ValueError, match="divide"):
+        ps.shard_map(lambda p, s, x: bn.apply(p, s, x, train=True),
+                     in_specs=(P(), P(), P(ps.DATA_AXIS)),
+                     out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+
+
+# -- ASP -------------------------------------------------------------------
+
+def test_m4n2_mask_pattern():
+    w = jnp.asarray([[0.1, -3.0, 2.0, 0.05] * 4,
+                     [4.0, 3.0, -2.0, 1.0] * 4], jnp.float32)
+    m = np.asarray(m4n2_1d_mask(w))
+    assert m.sum() == w.size // 2                   # exactly 50%
+    assert m.reshape(2, 4, 4).sum(-1).min() == 2    # 2 per group of 4
+    # keeps the two largest magnitudes of [0.1, -3, 2, 0.05]
+    np.testing.assert_array_equal(m[0, :4], [False, True, True, False])
+
+
+def test_mask_tree_predicate():
+    params = {"w": jnp.ones((16, 64)), "b": jnp.ones((64,)),
+              "tiny": jnp.ones((2, 4))}
+    masks = compute_sparse_masks(params)
+    assert np.asarray(masks["w"]).sum() == 16 * 32   # pruned
+    assert np.asarray(masks["b"]).all()              # 1-D skipped
+    assert np.asarray(masks["tiny"]).all()           # too small
+
+
+def test_wrapped_optimizer_keeps_sparsity():
+    asp = ASP()
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 64))}
+    masks = asp.compute_sparse_masks(params)
+    params = apply_masks(params, masks)
+    opt = FusedSGD(lr=0.1, momentum=0.9)
+    state = opt.init(params)
+    step = asp.wrap_optimizer(opt, masks)
+    for i in range(3):
+        grads = {"w": jax.random.normal(jax.random.PRNGKey(i), (16, 64))}
+        params, state = step(grads, params, state)
+    w = np.asarray(params["w"])
+    assert (w[~np.asarray(masks["w"])] == 0).all()   # pruned slots stay 0
+    assert (w != 0).sum() == w.size // 2
