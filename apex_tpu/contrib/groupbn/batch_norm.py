@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.models import layers as L
 from apex_tpu.transformer import parallel_state as ps
 
 
@@ -27,7 +28,12 @@ class BatchNorm2d_NHWC:
     """``init() -> (params, running_state)``; ``apply(params, state, x,
     z=None, train=...) -> (y, new_state)``. ``bn_group=0`` syncs across
     the WHOLE axis; ``bn_group=1`` is rank-local (the reference
-    default); ``k > 1`` syncs consecutive groups of k ranks."""
+    default); ``k > 1`` syncs consecutive groups of k ranks.
+
+    The stat machinery is ``layers.batchnorm`` (the one SyncBN uses)
+    with an ``axis_index_groups`` restriction — one implementation, one
+    momentum convention (this class exposes torch's UPDATE fraction,
+    default 0.1, and hands the keep fraction down)."""
 
     def __init__(self, num_features: int, *, fuse_relu: bool = False,
                  bn_group: int = 1, momentum: float = 0.1,
@@ -42,15 +48,9 @@ class BatchNorm2d_NHWC:
             ps.DATA_AXIS
 
     def init(self) -> Tuple[Dict, Dict]:
-        params = {"scale": jnp.ones((self.num_features,), jnp.float32),
-                  "bias": jnp.zeros((self.num_features,), jnp.float32)}
-        state = {"mean": jnp.zeros((self.num_features,), jnp.float32),
-                 "var": jnp.ones((self.num_features,), jnp.float32)}
-        return params, state
+        return L.init_batchnorm(self.num_features)
 
     def _groups(self):
-        if self.bn_group == 1:
-            return None  # rank-local stats: no collective at all
         n = lax.axis_size(self.axis_name)
         k = n if self.bn_group == 0 else self.bn_group
         if n % k:
@@ -61,39 +61,21 @@ class BatchNorm2d_NHWC:
     def apply(self, params: Dict, state: Dict, x: jax.Array,
               z: Optional[jax.Array] = None, *, train: bool = True
               ) -> Tuple[jax.Array, Dict]:
-        x32 = x.astype(jnp.float32)
-        if train:
-            axes = tuple(range(x.ndim - 1))
-            mean = jnp.mean(x32, axis=axes)
-            mean_sq = jnp.mean(jnp.square(x32), axis=axes)
-            if self.bn_group != 1:
-                groups = self._groups()
-                mean = lax.pmean(mean, self.axis_name,
-                                 axis_index_groups=groups)
-                mean_sq = lax.pmean(mean_sq, self.axis_name,
-                                    axis_index_groups=groups)
-            var = mean_sq - jnp.square(mean)
-            n = x32.size // x32.shape[-1]
-            if self.bn_group != 1:
-                n = n * (lax.axis_size(self.axis_name)
-                         if self.bn_group == 0 else self.bn_group)
-            unbiased = var * (n / max(n - 1, 1))
-            new_state = {
-                "mean": (1 - self.momentum) * state["mean"]
-                + self.momentum * mean,
-                "var": (1 - self.momentum) * state["var"]
-                + self.momentum * unbiased,
-            }
-        else:
-            mean, var = state["mean"], state["var"]
-            new_state = state
-        y = (x32 - mean) * lax.rsqrt(var + self.eps)
-        y = y * params["scale"] + params["bias"]
-        if z is not None:
-            # the fused add epilogue (reference: bn_add_relu kernel)
-            y = y + z.astype(jnp.float32)
-        if self.fuse_relu:
-            y = jax.nn.relu(y)
-        return y.astype(x.dtype), new_state
+        sync = self.bn_group != 1  # bn_group=1: rank-local, no collective
+        y, new_state = L.batchnorm(
+            params, state, x, train=train,
+            momentum=1.0 - self.momentum, eps=self.eps,
+            axis_name=self.axis_name if (sync and train) else None,
+            axis_index_groups=self._groups() if (sync and train) else None)
+        if z is not None or self.fuse_relu:
+            # the fused add+ReLU epilogue (reference: bn_add_relu kernel);
+            # XLA fuses this into the normalization's elementwise chain
+            y32 = y.astype(jnp.float32)
+            if z is not None:
+                y32 = y32 + z.astype(jnp.float32)
+            if self.fuse_relu:
+                y32 = jax.nn.relu(y32)
+            y = y32.astype(x.dtype)
+        return y, new_state
 
     __call__ = apply

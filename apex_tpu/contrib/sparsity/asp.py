@@ -19,45 +19,55 @@ import jax
 import jax.numpy as jnp
 
 
-def m4n2_1d_mask(w: jax.Array) -> jax.Array:
-    """Boolean keep-mask: top-2-of-4 |w| along the LAST dim (ref:
-    ``mn_1d_best`` with m=4, n=2). Last dim must divide by 4."""
+def m4n2_1d_mask(w: jax.Array, axis: int = 0) -> jax.Array:
+    """Boolean keep-mask: top-2-of-4 |w| along ``axis`` (ref:
+    ``mn_1d_best`` with m=4, n=2, applied to torch Linear's LAST dim —
+    which is the INPUT dim of torch's (out, in) layout). This package's
+    dense kernels are (in, out), so the contraction dim is axis 0 and
+    that is the default: the 2:4 pattern must run along the dim the GEMM
+    contracts or sparse tensor cores reject the export."""
+    w = jnp.moveaxis(w, axis, -1)
     if w.shape[-1] % 4:
         raise ValueError(
-            f"last dim {w.shape[-1]} not divisible by 4 (m4n2 pattern)")
+            f"pruning dim {w.shape[-1]} not divisible by 4 (m4n2 pattern)")
     groups = jnp.abs(w).reshape(*w.shape[:-1], w.shape[-1] // 4, 4)
     # rank within each group; keep the two largest magnitudes
     order = jnp.argsort(jnp.argsort(groups, axis=-1), axis=-1)
     keep = order >= 2
-    return keep.reshape(w.shape)
+    return jnp.moveaxis(keep.reshape(w.shape), -1, axis)
 
 
 def _default_predicate(path: tuple, leaf: jax.Array) -> bool:
-    """Prunable = float matrices with a 4-divisible contraction dim and
-    both dims >= 16 (the reference skips embeddings/small/1-D params via
-    its whitelist; path is available for custom predicates)."""
-    return (leaf.ndim == 2 and leaf.shape[-1] % 4 == 0
+    """Prunable = float matrices with a 4-divisible contraction (first)
+    dim and both dims >= 16 (the reference skips embeddings/small/1-D
+    params via its whitelist; path is available for custom predicates)."""
+    return (leaf.ndim == 2 and leaf.shape[0] % 4 == 0
             and min(leaf.shape) >= 16
             and jnp.issubdtype(leaf.dtype, jnp.floating))
 
 
 def compute_sparse_masks(params: Any,
                          predicate: Optional[Callable] = None) -> Any:
-    """Mask pytree: m4n2 masks for prunable leaves, all-True otherwise
-    (ref: ``ASP.compute_sparse_masks`` walking the module whitelist)."""
+    """Mask pytree: m4n2 masks for prunable leaves; non-prunable leaves
+    hold the scalar ``True`` sentinel — no dense all-True arrays (a byte
+    per element across a mostly-non-prunable model is real HBM) and
+    ``apply_masks`` skips them entirely (ref:
+    ``ASP.compute_sparse_masks`` walking the module whitelist)."""
     pred = predicate or _default_predicate
 
     def mask_of(path, leaf):
         if pred(path, leaf):
             return m4n2_1d_mask(leaf)
-        return jnp.ones(leaf.shape, bool)
+        return True
 
     return jax.tree_util.tree_map_with_path(mask_of, params)
 
 
 def apply_masks(params: Any, masks: Any) -> Any:
     return jax.tree.map(
-        lambda p, m: jnp.where(m, p, jnp.zeros_like(p)), params, masks)
+        lambda p, m: p if m is True
+        else jnp.where(m, p, jnp.zeros_like(p)),
+        params, masks)
 
 
 class ASP:

@@ -89,14 +89,20 @@ def init_batchnorm(ch: int) -> Tuple[dict, dict]:
 def batchnorm(params: Optional[dict], state: Optional[dict],
               x: jax.Array, *, train: bool,
               momentum: float = 0.9, eps: float = 1e-5,
-              axis_name: Optional[str] = None
+              axis_name: Optional[str] = None,
+              axis_index_groups=None
               ) -> Tuple[jax.Array, Optional[dict]]:
     """BatchNorm over all but the channel (last) axis.
 
     ``axis_name``: when set and running inside shard_map/pmap, batch
     statistics are averaged across that mesh axis — this is the SyncBN hook
     used by ``apex_tpu.parallel.SyncBatchNorm`` (ref:
-    ``apex/parallel/sync_batchnorm.py``).
+    ``apex/parallel/sync_batchnorm.py``). ``axis_index_groups`` limits the
+    sync to rank subgroups (the groupbn ``bn_group`` hook).
+
+    ``momentum`` is the KEEP fraction (new = momentum·old +
+    (1-momentum)·batch); the module wrappers expose torch's update
+    fraction and pass ``1 - momentum`` here.
 
     ``params=None`` skips the affine transform (``affine=False``);
     ``state=None`` means no running stats are tracked — batch statistics
@@ -110,13 +116,16 @@ def batchnorm(params: Optional[dict], state: Optional[dict],
         mean = jnp.mean(x32, axis=axes)
         mean_sq = jnp.mean(jnp.square(x32), axis=axes)
         if axis_name is not None:
-            mean = lax.pmean(mean, axis_name)
-            mean_sq = lax.pmean(mean_sq, axis_name)
+            mean = lax.pmean(mean, axis_name,
+                             axis_index_groups=axis_index_groups)
+            mean_sq = lax.pmean(mean_sq, axis_name,
+                                axis_index_groups=axis_index_groups)
         var = mean_sq - jnp.square(mean)
         if train and state is not None:
             n = x32.size // x32.shape[-1]
             if axis_name is not None:
-                n = n * lax.psum(1, axis_name)
+                n = n * lax.psum(1, axis_name,
+                                 axis_index_groups=axis_index_groups)
             unbiased = var * (n / max(n - 1, 1))
             new_state = {
                 "mean": momentum * state["mean"] + (1 - momentum) * mean,

@@ -34,10 +34,13 @@ def test_bn_group_equals_subgroup_stats():
     params, state = bn.init()
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 5, 5, 6)) * 2 + 1
 
-    y, _ = ps.shard_map(
-        lambda p, s, x: bn.apply(p, s, x, train=True),
+    # NB: only y comes back — under bn_group=4 the two rank-groups hold
+    # DIFFERENT running stats, so a replicated P() out_spec for the state
+    # would silently pick one group's copy
+    y = ps.shard_map(
+        lambda p, s, x: bn.apply(p, s, x, train=True)[0],
         in_specs=(P(), P(), P(ps.DATA_AXIS)),
-        out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+        out_specs=P(ps.DATA_AXIS))(params, state, x)
 
     bnp, bns = L.init_batchnorm(6)
     y_ref = jnp.concatenate([
@@ -52,10 +55,10 @@ def test_bn_group_zero_syncs_whole_axis():
     bn = BatchNorm2d_NHWC(4, bn_group=0)
     params, state = bn.init()
     x = jax.random.normal(jax.random.PRNGKey(1), (16, 4)) * 3 - 1
-    y, _ = ps.shard_map(
-        lambda p, s, x: bn.apply(p, s, x, train=True),
+    y = ps.shard_map(
+        lambda p, s, x: bn.apply(p, s, x, train=True)[0],
         in_specs=(P(), P(), P(ps.DATA_AXIS)),
-        out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+        out_specs=P(ps.DATA_AXIS))(params, state, x)
     y = np.asarray(y)
     np.testing.assert_allclose(y.mean(0), 0.0, atol=1e-5)
     np.testing.assert_allclose(y.var(0), 1.0, rtol=1e-3)
@@ -79,21 +82,35 @@ def test_bn_group_divisibility_error():
     params, state = bn.init()
     x = jnp.ones((16, 4))
     with pytest.raises(ValueError, match="divide"):
-        ps.shard_map(lambda p, s, x: bn.apply(p, s, x, train=True),
+        ps.shard_map(lambda p, s, x: bn.apply(p, s, x, train=True)[0],
                      in_specs=(P(), P(), P(ps.DATA_AXIS)),
-                     out_specs=(P(ps.DATA_AXIS), P()))(params, state, x)
+                     out_specs=P(ps.DATA_AXIS))(params, state, x)
 
 
 # -- ASP -------------------------------------------------------------------
 
 def test_m4n2_mask_pattern():
+    # explicit axis=-1: the torch-layout orientation
     w = jnp.asarray([[0.1, -3.0, 2.0, 0.05] * 4,
                      [4.0, 3.0, -2.0, 1.0] * 4], jnp.float32)
-    m = np.asarray(m4n2_1d_mask(w))
+    m = np.asarray(m4n2_1d_mask(w, axis=-1))
     assert m.sum() == w.size // 2                   # exactly 50%
     assert m.reshape(2, 4, 4).sum(-1).min() == 2    # 2 per group of 4
     # keeps the two largest magnitudes of [0.1, -3, 2, 0.05]
     np.testing.assert_array_equal(m[0, :4], [False, True, True, False])
+
+
+def test_m4n2_default_axis_is_contraction_dim():
+    """This package's kernels are (in, out): the 2:4 groups must run
+    DOWN the input dim (axis 0) so the pattern survives transposition to
+    torch's (out, in) sparse-tensor-core layout."""
+    w = jnp.asarray([[0.1], [-3.0], [2.0], [0.05],
+                     [4.0], [3.0], [-2.0], [1.0]], jnp.float32)
+    m = np.asarray(m4n2_1d_mask(w))                 # default axis=0
+    np.testing.assert_array_equal(
+        m[:, 0], [False, True, True, False, True, True, False, False])
+    # groups of 4 along axis 0, 2 kept per group
+    assert m.reshape(2, 4).sum(-1).tolist() == [2, 2]
 
 
 def test_mask_tree_predicate():
@@ -101,8 +118,8 @@ def test_mask_tree_predicate():
               "tiny": jnp.ones((2, 4))}
     masks = compute_sparse_masks(params)
     assert np.asarray(masks["w"]).sum() == 16 * 32   # pruned
-    assert np.asarray(masks["b"]).all()              # 1-D skipped
-    assert np.asarray(masks["tiny"]).all()           # too small
+    assert masks["b"] is True                        # 1-D: sentinel
+    assert masks["tiny"] is True                     # too small: sentinel
 
 
 def test_wrapped_optimizer_keeps_sparsity():
