@@ -487,8 +487,11 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int
         params = init_gpt(jax.random.PRNGKey(0), cfg)
         opt = FusedAdam(lr=1e-4, weight_decay=0.01)
         opt_state = opt.init(params)
+        # bf16 compute over fp32 params (O2-style: optimizer math fp32):
+        # measured 30.0 vs 23.5 samples/s over fp32 compute on v5e
         vg = jax.value_and_grad(
-            lambda p: gpt_loss_unsharded(p, cfg, ids, labels))
+            lambda p: gpt_loss_unsharded(p, cfg, ids, labels,
+                                         compute_dtype=jnp.bfloat16))
 
         def body1(state):
             p, o = state
@@ -514,7 +517,10 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int
     labels = jnp.zeros((batch, seq), jnp.int32)
 
     loss_grad = ps.shard_map(
-        jax.value_and_grad(model.loss, argnums=0), mesh=mesh,
+        jax.value_and_grad(
+            lambda p, i, t: model.loss(p, i, t,
+                                       compute_dtype=jnp.bfloat16),
+            argnums=0), mesh=mesh,
         in_specs=(specs, P(), P()),
         out_specs=(P(), specs))
 
