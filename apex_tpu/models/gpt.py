@@ -62,6 +62,11 @@ class GPTConfig:
     # plus recompute — mandatory at gpt_medium scale on one chip (ref
     # analogue: Megatron's --recompute-granularity)
     remat: bool = False
+    # Megatron sequence parallelism: activations OUTSIDE the TP regions
+    # (LN, residuals, dropout) are sharded along seq over the model axis
+    # (seq_dim=1 in this model's (b, s, h) layout); Column gathers /
+    # Row reduce-scatters at the region edges. Requires seq % tp == 0.
+    sequence_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -263,14 +268,16 @@ class GPTModel:
             raise ValueError(
                 f"num_heads {cfg.num_heads} not divisible by tp {t} "
                 "(attention heads shard over the model axis)")
+        sp = dict(sequence_parallel_enabled=cfg.sequence_parallel,
+                  sequence_parallel_seq_dim=1)  # (b, s, h) layout
         self.qkv = tp.ColumnParallelLinear(h, 3 * h, gather_output=False,
-                                           tp_size=tp_size)
+                                           tp_size=tp_size, **sp)
         self.out = tp.RowParallelLinear(h, h, input_is_parallel=True,
-                                        tp_size=tp_size)
+                                        tp_size=tp_size, **sp)
         self.fc1 = tp.ColumnParallelLinear(h, f, gather_output=False,
-                                           tp_size=tp_size)
+                                           tp_size=tp_size, **sp)
         self.fc2 = tp.RowParallelLinear(f, h, input_is_parallel=True,
-                                        tp_size=tp_size)
+                                        tp_size=tp_size, **sp)
         self.embed = tp.VocabParallelEmbedding(cfg.vocab_size, h,
                                                tp_size=tp_size)
 
@@ -285,6 +292,8 @@ class GPTModel:
               compute_dtype=None) -> jax.Array:
         """ids (b, s) -> hidden (b, s, h). Inside shard_map over the
         ``model`` axis (tp=1 mesh is fine)."""
+        from apex_tpu.transformer.tensor_parallel import mappings
+
         cfg = self.cfg
         b, s = input_ids.shape
         x = self.embed.apply(params["embedding"]["word"], input_ids)
@@ -294,6 +303,15 @@ class GPTModel:
             pos = params["embedding"]["position"]["embedding"][:s]
             x = x + pos.astype(x.dtype)[None]
         freqs = _rope_or_none(cfg, s)
+        if cfg.sequence_parallel:
+            # enter the SP region: shard seq over the model axis; the
+            # attention itself still sees the full sequence (Column
+            # gathers it back). Decorrelate per-rank dropout streams —
+            # ranks hold different tokens.
+            x = mappings.scatter_to_sequence_parallel_region(x, 1)
+            if dropout_rng is not None:
+                dropout_rng = jax.random.fold_in(
+                    dropout_rng, lax.axis_index(ps.TENSOR_AXIS))
         x = _scan_layers(x, params["layers"], cfg, freqs,
                          self.qkv.apply, self.out.apply,
                          self.fc1.apply, self.fc2.apply, dropout_rng)
@@ -306,6 +324,27 @@ class GPTModel:
         table = params["embedding"]["word"]["embedding"]
         return _tied_lm_logits(hidden, table)
 
+    def allreduce_sequence_parallel_grads(self, grads: Dict[str, Any]
+                                          ) -> Dict[str, Any]:
+        """SP closure (ref: Megatron's
+        ``allreduce_sequence_parallel_gradients`` step, which the
+        training loop runs after backward): params that live in the
+        sequence-parallel region — the layer norms and the Row-parallel
+        biases — see only the local tokens' grads on each rank; psum
+        them over the model axis. No-op when SP is off."""
+        if not self.cfg.sequence_parallel:
+            return grads
+
+        def fix(path, g):
+            keys = "/".join(str(getattr(k, "key", k)) for k in path)
+            if ("ln1" in keys or "ln2" in keys or "final_ln" in keys
+                    or ("out" in keys and "bias" in keys)
+                    or ("fc2" in keys and "bias" in keys)):
+                return lax.psum(g, ps.TENSOR_AXIS)
+            return g
+
+        return jax.tree_util.tree_map_with_path(fix, grads)
+
     def loss(self, params: Dict[str, Any], input_ids: jax.Array,
              labels: jax.Array, *,
              dropout_rng: Optional[jax.Array] = None,
@@ -316,9 +355,22 @@ class GPTModel:
             vocab_parallel_cross_entropy,
         )
 
+        from apex_tpu.transformer.tensor_parallel import mappings
+
         hidden = self.apply(params, input_ids, dropout_rng=dropout_rng,
                             compute_dtype=compute_dtype)
-        logits = self.logits_local(params, hidden)
+        if self.cfg.sequence_parallel:
+            # leave the SP region for the LM head; the gather's backward
+            # reduce-scatters dhidden — the SP dual of copy_to_region, so
+            # the head dots the gathered hidden directly
+            hidden = mappings.gather_from_sequence_parallel_region(
+                hidden, True, 1)
+            table = params["embedding"]["word"]["embedding"]
+            logits = jnp.dot(hidden,
+                             table.astype(hidden.dtype).T).astype(
+                jnp.float32)
+        else:
+            logits = self.logits_local(params, hidden)
         return vocab_parallel_cross_entropy(logits, labels).mean()
 
 

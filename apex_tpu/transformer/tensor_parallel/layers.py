@@ -51,12 +51,14 @@ class ColumnParallelLinear:
     def __init__(self, in_features: int, out_features: int, *,
                  bias: bool = True, gather_output: bool = True,
                  sequence_parallel_enabled: bool = False,
+                 sequence_parallel_seq_dim: int = 0,
                  params_dtype=jnp.float32, tp_size: Optional[int] = None):
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
         self.gather_output = gather_output
         self.sequence_parallel_enabled = sequence_parallel_enabled
+        self.sequence_parallel_seq_dim = sequence_parallel_seq_dim
         self.params_dtype = params_dtype
         if sequence_parallel_enabled and gather_output:
             raise ValueError(
@@ -84,7 +86,8 @@ class ColumnParallelLinear:
         if self.sequence_parallel_enabled:
             # x arrives seq-sharded; gather the full sequence for the GEMM
             # (bwd: reduce-scatter)
-            x = mappings.gather_from_sequence_parallel_region(x, True)
+            x = mappings.gather_from_sequence_parallel_region(
+                x, True, self.sequence_parallel_seq_dim)
         else:
             # fwd identity / bwd allreduce of dX across TP ranks
             x = mappings.copy_to_tensor_model_parallel_region(x)
@@ -105,12 +108,14 @@ class RowParallelLinear:
     def __init__(self, in_features: int, out_features: int, *,
                  bias: bool = True, input_is_parallel: bool = True,
                  sequence_parallel_enabled: bool = False,
+                 sequence_parallel_seq_dim: int = 0,
                  params_dtype=jnp.float32, tp_size: Optional[int] = None):
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
         self.input_is_parallel = input_is_parallel
         self.sequence_parallel_enabled = sequence_parallel_enabled
+        self.sequence_parallel_seq_dim = sequence_parallel_seq_dim
         self.params_dtype = params_dtype
         if sequence_parallel_enabled and not input_is_parallel:
             raise ValueError(
@@ -139,7 +144,8 @@ class RowParallelLinear:
             x = mappings.scatter_to_tensor_model_parallel_region(x)
         y = jnp.dot(x, params["kernel"].astype(x.dtype))
         if self.sequence_parallel_enabled:
-            y = mappings.reduce_scatter_to_sequence_parallel_region(y)
+            y = mappings.reduce_scatter_to_sequence_parallel_region(
+                y, self.sequence_parallel_seq_dim)
         else:
             y = mappings.reduce_from_tensor_model_parallel_region(y)
         if self.use_bias:

@@ -106,46 +106,49 @@ def _gather_bwd(_, g):
 gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
 
 
-# -- sequence-parallel region mappings (seq dim = axis 0) -------------------
+# -- sequence-parallel region mappings ---------------------------------------
+# seq dim defaults to axis 0 (Megatron (s, b, h)); models in (b, s, h)
+# layout pass seq_dim=1 — the collectives are dim-agnostic.
 
-@jax.custom_vjp
-def scatter_to_sequence_parallel_region(x):
-    return _split_along(x, 0)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, seq_dim: int = 0):
+    return _split_along(x, seq_dim)
 
-def _sp_scatter_fwd(x):
-    return _split_along(x, 0), None
+def _sp_scatter_fwd(x, seq_dim):
+    return _split_along(x, seq_dim), None
 
-def _sp_scatter_bwd(_, g):
-    return (_gather_along(g, 0),)
+def _sp_scatter_bwd(seq_dim, _, g):
+    return (_gather_along(g, seq_dim),)
 
 scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_from_sequence_parallel_region(x, to_model_parallel: bool = True):
-    return _gather_along(x, 0)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, to_model_parallel: bool = True,
+                                         seq_dim: int = 0):
+    return _gather_along(x, seq_dim)
 
-def _sp_gather_fwd(x, to_model_parallel):
-    return _gather_along(x, 0), None
+def _sp_gather_fwd(x, to_model_parallel, seq_dim):
+    return _gather_along(x, seq_dim), None
 
-def _sp_gather_bwd(to_model_parallel, _, g):
+def _sp_gather_bwd(to_model_parallel, seq_dim, _, g):
     # entering a TP region: the dual is reduce-scatter (grads from all TP
     # ranks must be summed); leaving to a pure SP consumer: plain split
     if to_model_parallel:
-        return (_reduce_scatter_along(g, 0),)
-    return (_split_along(g, 0),)
+        return (_reduce_scatter_along(g, seq_dim),)
+    return (_split_along(g, seq_dim),)
 
 gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 
 
-@jax.custom_vjp
-def reduce_scatter_to_sequence_parallel_region(x):
-    return _reduce_scatter_along(x, 0)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, seq_dim: int = 0):
+    return _reduce_scatter_along(x, seq_dim)
 
-def _sp_rs_fwd(x):
-    return _reduce_scatter_along(x, 0), None
+def _sp_rs_fwd(x, seq_dim):
+    return _reduce_scatter_along(x, seq_dim), None
 
-def _sp_rs_bwd(_, g):
-    return (_gather_along(g, 0),)
+def _sp_rs_bwd(seq_dim, _, g):
+    return (_gather_along(g, seq_dim),)
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -30,10 +30,12 @@ def _data(cfg):
     return ids, labels
 
 
-@pytest.mark.parametrize("use_rope", [False, True])
-def test_tp8_loss_and_grads_match_unsharded(use_rope):
+@pytest.mark.parametrize("use_rope,sequence_parallel", [
+    (False, False), (True, False), (False, True)])
+def test_tp8_loss_and_grads_match_unsharded(use_rope, sequence_parallel):
     cfg = gpt_tiny()
-    cfg = type(cfg)(**{**cfg.__dict__, "use_rope": use_rope})
+    cfg = type(cfg)(**{**cfg.__dict__, "use_rope": use_rope,
+                       "sequence_parallel": sequence_parallel})
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=8)
     model = GPTModel(cfg, tp_size=8)
     params = init_gpt(jax.random.PRNGKey(0), cfg)
@@ -43,8 +45,16 @@ def test_tp8_loss_and_grads_match_unsharded(use_rope):
         lambda p: gpt_loss_unsharded(p, cfg, ids, labels))(params)
 
     specs = model.partition_specs()
+
+    def loss_and_grads(p, ids, labels):
+        loss, grads = jax.value_and_grad(model.loss, argnums=0)(
+            p, ids, labels)
+        # SP: LN/Row-bias grads are per-rank partial sums (ref: Megatron
+        # allreduces sequence-parallel grads after backward)
+        return loss, model.allreduce_sequence_parallel_grads(grads)
+
     got_loss, got_grads = ps.shard_map(
-        jax.value_and_grad(model.loss, argnums=0),
+        loss_and_grads,
         in_specs=(specs, P(), P()), out_specs=(P(), specs))(
         params, ids, labels)
 
