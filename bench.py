@@ -279,6 +279,36 @@ def bench_tp_gpt(on_tpu):
          extra={"devices": n, "step_ms": round(dt * 1e3, 2)})
 
 
+# -- flash-attention microbench: kernel vs unfused at long seq --------------
+
+def bench_flash_attention(on_tpu):
+    """fwd+bwd at seq 2048 (b·h·s·d sized for one chip): the Pallas
+    kernel vs XLA's materialized-scores path — the dispatch-crossover
+    evidence (flash_attention.py picks the kernel above seq 256)."""
+    from apex_tpu.transformer.functional import flash_attention
+
+    b, h, s, d = (4, 16, 2048, 64) if on_tpu else (1, 2, 256, 16)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+
+    for name, use_kernel in (("kernel", True), ("unfused", False)):
+        def body(q, uk=use_kernel):
+            g = jax.grad(lambda q: jnp.sum(flash_attention(
+                q, k, v, causal=True, use_kernel=uk).astype(jnp.float32)
+                ** 2))(q)
+            return (g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-6)).astype(
+                q.dtype)
+
+        dt = timed(body, q, lambda x: jnp.sum(x.astype(jnp.float32)),
+                   M=10 if on_tpu else 2)
+        # causal attention FLOPs: ~2·(QK + PV + bwd≈2.5x) over s²/2
+        flops = 2 * 3.5 * b * h * s * s * d
+        emit(f"flash_attention_{name}_seq{s}_fwdbwd", dt * 1e3, "ms/iter",
+             extra={"tflops": round(flops / dt / 1e12, 1)},
+             higher_is_better=False)
+
+
 # -- config 1/headline: BERT-Large pretrain step ----------------------------
 
 def bench_headline(on_tpu):
@@ -312,6 +342,7 @@ CONFIGS = {
     "opt_flat_vs_tree": bench_flat_vs_tree_many_tensors,
     "ddp_bert": bench_ddp_bert,
     "tp_gpt": bench_tp_gpt,
+    "flash_attention": bench_flash_attention,
     "headline": bench_headline,
 }
 
