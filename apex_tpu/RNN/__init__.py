@@ -11,4 +11,11 @@ from apex_tpu.RNN.cells import (  # noqa: F401
     rnn_relu_cell,
     rnn_tanh_cell,
 )
-from apex_tpu.RNN.models import GRU, LSTM, RNN, mLSTM  # noqa: F401
+from apex_tpu.RNN.models import (  # noqa: F401
+    GRU,
+    LSTM,
+    RNN,
+    RNNReLU,
+    RNNTanh,
+    mLSTM,
+)

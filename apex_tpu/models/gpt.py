@@ -488,11 +488,17 @@ def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
     cfg = model.cfg
 
     def embed_fn(embed_params, mb):
+        from apex_tpu.transformer.tensor_parallel import mappings
+
         ids = mb["input_ids"]
         x = model.embed.apply(embed_params["word"], ids)
         if not cfg.use_rope:
             pos = embed_params["position"]["embedding"][:ids.shape[1]]
             x = x + pos.astype(x.dtype)[None]
+        if cfg.sequence_parallel:
+            # hidden states travel the pipe seq-sharded; each stage's
+            # Column layers gather / Row layers re-scatter internally
+            x = mappings.scatter_to_sequence_parallel_region(x, 1)
         return x
 
     def stage_fn(stage_params, x):
@@ -507,8 +513,19 @@ def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
         return x
 
     def loss_fn(head_params, hidden, mb):
+        from apex_tpu.transformer.tensor_parallel import mappings
+
         hidden = _ln(head_params["final_ln"], hidden, cfg.layer_norm_eps)
-        logits = _tied_lm_logits(hidden, head_params["word"]["embedding"])
+        if cfg.sequence_parallel:
+            hidden = mappings.gather_from_sequence_parallel_region(
+                hidden, True, 1)
+            table = head_params["word"]["embedding"]
+            logits = jnp.dot(hidden,
+                             table.astype(hidden.dtype).T).astype(
+                jnp.float32)
+        else:
+            logits = _tied_lm_logits(hidden,
+                                     head_params["word"]["embedding"])
         return vocab_parallel_cross_entropy(logits, mb["labels"]).mean()
 
     return PipelineModel(embed_fn, stage_fn, loss_fn)

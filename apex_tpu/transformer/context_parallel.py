@@ -74,11 +74,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q32 = q.astype(jnp.float32)
     q_pos = rank * s_loc + jnp.arange(s_loc)          # global q positions
 
-    if mask is None:
-        mask_loc = jnp.ones((b, s_loc), jnp.int32)
-    else:
-        mask_loc = mask.astype(jnp.int32)
-
     def block(carry_qstate, kv_block, src_rank):
         """One flash-recurrence update against the k/v block that
         originated on ``src_rank``."""
@@ -86,11 +81,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k_blk, v_blk, kmask_blk = kv_block
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
                        k_blk.astype(jnp.float32)) * softmax_scale
-        valid = (kmask_blk[:, None, None, :] != 0)
+        valid = None
+        if kmask_blk is not None:
+            valid = (kmask_blk[:, None, None, :] != 0)
         if causal:
             k_pos = src_rank * s_loc + jnp.arange(s_loc)
-            valid &= (k_pos[None, None, None, :]
-                      <= q_pos[None, None, :, None])
+            tri = (k_pos[None, None, None, :]
+                   <= q_pos[None, None, :, None])
+            valid = tri if valid is None else (valid & tri)
+        if valid is None:
+            valid = jnp.ones(s.shape, bool)
         s = jnp.where(valid, s, _NEG)
         m_cur = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_run - m_cur)
@@ -103,20 +103,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if checkpoint_blocks:
         block = jax.checkpoint(block)
 
+    # the mask rides the ring only when one exists (causal needs none)
+    mask_loc = None if mask is None else mask.astype(jnp.int32)
+
     def step(carry, j):
         qstate, k_cur, v_cur, km_cur = carry
         src = (rank - j) % cp                 # who this block belongs to
         qstate = block(qstate, (k_cur, v_cur, km_cur), src)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        m_nxt = lax.ppermute(km_cur, axis_name, perm)
+        m_nxt = None if km_cur is None else \
+            lax.ppermute(km_cur, axis_name, perm)
         return (qstate, k_nxt, v_nxt, m_nxt), None
 
     m0 = jnp.full((b, h, s_loc, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    (qstate, _, _, _), _ = lax.scan(
-        step, ((m0, l0, acc0), k, v, mask_loc), jnp.arange(cp))
+    # cp-1 rotate-and-consume steps, then the final block OUTSIDE the
+    # scan — rotating after the last consume would send a full k/v/mask
+    # round over ICI just to discard it
+    carry = ((m0, l0, acc0), k, v, mask_loc)
+    if cp > 1:
+        carry, _ = lax.scan(step, carry, jnp.arange(cp - 1))
+    qstate, k_last, v_last, km_last = carry
+    qstate = block(qstate, (k_last, v_last, km_last),
+                   (rank - (cp - 1)) % cp)
     _, l_run, acc = qstate
     out = jnp.where(l_run > 0, acc / jnp.where(l_run > 0, l_run, 1.0), 0.0)
     return out.astype(q.dtype)

@@ -145,8 +145,6 @@ def _make_optimizer(which):
 
     return {
         "adam": lambda: FusedAdam(lr=1e-4, weight_decay=0.01),
-        "adam_flat": lambda: FusedAdam(lr=1e-4, weight_decay=0.01,
-                                       use_flat_kernel=True),
         "lamb": lambda: FusedLAMB(lr=1e-3, weight_decay=0.01),
     }[which]()
 

@@ -55,11 +55,16 @@ def main():
     dp = ps.get_data_parallel_world_size()
     print(f"mesh: dp={dp} tp={tp_sz} pp={pp}", flush=True)
 
+    if ns.context_parallel_size > 1:
+        raise SystemExit(
+            "this script does not drive context parallelism — use "
+            "transformer.context_parallel.ring_attention directly")
     cfg = GPTConfig(
         vocab_size=ns.padded_vocab_size, hidden_size=ns.hidden_size,
         num_layers=ns.num_layers, num_heads=ns.num_attention_heads,
         ffn_hidden_size=4 * ns.hidden_size,
-        max_position_embeddings=ns.max_position_embeddings)
+        max_position_embeddings=ns.max_position_embeddings,
+        sequence_parallel=ns.sequence_parallel)
     model = GPTModel(cfg, tp_size=tp_sz)
     params = init_gpt(jax.random.PRNGKey(ns.seed), cfg)
     pipe_params = gpt_to_pipeline_params(params, cfg, pp)
@@ -85,16 +90,33 @@ def main():
         ospecs = type(opt_state)(step=P(), m=pspecs, v=pspecs)
 
     # microbatches are per DATA-rank: local batch = global / dp
+    if ns.global_batch_size % dp:
+        raise SystemExit(f"--global-batch-size {ns.global_batch_size} "
+                         f"not divisible by dp {dp}")
     local_batch = ns.global_batch_size // dp
-    M = max(1, local_batch // max(ns.micro_batch_size, 1))
+    if local_batch % ns.micro_batch_size:
+        raise SystemExit(
+            f"local batch {local_batch} (global/dp) not divisible by "
+            f"--micro-batch-size {ns.micro_batch_size} (Megatron errors "
+            "here too; silent re-sizing would train a different config)")
+    M = local_batch // ns.micro_batch_size
     fwd_bwd = (forward_backward_pipelining_without_interleaving if pp > 1
                else forward_backward_no_pipelining)
 
     def train_step(p, ostate, batch):
         loss, grads = fwd_bwd(pipe_model, p, batch, num_microbatches=M)
-        if pp > 1:
-            pass  # schedule already psums loss over pipe
         loss = lax.pmean(loss, ps.DATA_AXIS)
+        # tied embedding: the pipeline layout holds the word table twice
+        # (embed lookup + LM head) and each copy gets a PARTIAL grad; sum
+        # them into BOTH slots so the copies take identical updates and
+        # stay tied (Megatron's shared-embedding allreduce)
+        grads = dict(grads)
+        tied = jax.tree.map(jnp.add, grads["embed"]["word"],
+                            grads["head"]["word"])
+        grads["embed"] = dict(grads["embed"], word=tied)
+        grads["head"] = dict(grads["head"], word=tied)
+        # SP: LN/Row-bias grads are per-rank partials over the model axis
+        grads = model.allreduce_sequence_parallel_grads(grads)
         if ns.use_distributed_optimizer:
             # ZeRO: rank-local grads in, reduce-scatter inside the step
             p, ostate = opt.step(grads, p, ostate)

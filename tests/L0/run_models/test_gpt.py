@@ -80,17 +80,22 @@ def test_tp1_runs_without_sharding_surprises():
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
-@pytest.mark.parametrize("pp,vpp", [(2, None), (4, None), (2, 2)])
-def test_pipeline_gpt_matches_unsharded(pp, vpp):
-    """GPT through the collective pipeline schedules (tp=1, pp=N) —
-    loss parity with the unsharded model and grad parity for the stages."""
+@pytest.mark.parametrize("pp,vpp,tp,sp", [
+    (2, None, 1, False), (4, None, 1, False), (2, 2, 1, False),
+    (2, None, 2, True)])
+def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp):
+    """GPT through the collective pipeline schedules — loss parity with
+    the unsharded model and grad parity for the stages (incl. the
+    tp=2 + sequence-parallel combination riding the pipe)."""
     from apex_tpu.transformer.pipeline_parallel import schedules
 
     cfg = gpt_tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "sequence_parallel": sp})
     ps.initialize_model_parallel(
+        tensor_model_parallel_size_=tp,
         pipeline_model_parallel_size_=pp,
         virtual_pipeline_model_parallel_size_=vpp)
-    model = GPTModel(cfg, tp_size=1)
+    model = GPTModel(cfg, tp_size=tp)
     params = init_gpt(jax.random.PRNGKey(0), cfg)
     ids, labels = _data(cfg)
     batch = {"input_ids": ids, "labels": labels}
@@ -106,9 +111,14 @@ def test_pipeline_gpt_matches_unsharded(pp, vpp):
     specs = gpt_pipeline_partition_specs(cfg, vpp)
 
     kw = {"virtual_pipeline_size": vpp} if vpp else {}
+
+    def run(p, b):
+        loss, grads = fwd_bwd(pipe_model, p, b, num_microbatches=4, **kw)
+        return loss, model.allreduce_sequence_parallel_grads(grads)
+
     loss, grads = jax.jit(ps.shard_map(
-        lambda p, b: fwd_bwd(pipe_model, p, b, num_microbatches=4, **kw),
-        in_specs=(specs, P()), out_specs=(P(), specs)))(pipe_params, batch)
+        run, in_specs=(specs, P()), out_specs=(P(), specs)))(
+        pipe_params, batch)
 
     # golden: microbatched unsharded loss (same microbatch mean-of-means)
     want_loss = gpt_loss_unsharded(params, cfg, ids, labels)
