@@ -102,7 +102,8 @@ def _fwd_kernel(*refs, mode: str, eps: float, has_w: bool, has_b: bool):
     y_ref[:] = y.astype(y_ref.dtype)
 
 
-def _bwd_kernel(*refs, mode: str, has_w: bool, has_b: bool):
+def _bwd_kernel(*refs, mode: str, has_w: bool, has_b: bool,
+                accum_parts: bool = False):
     it = iter(refs)
     dy_ref = next(it)
     x_ref = next(it)
@@ -130,9 +131,26 @@ def _bwd_kernel(*refs, mode: str, has_w: bool, has_b: bool):
         dx = (wdy - xhat * c1) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
-    # dgamma/dbeta: partial (1, H) sums accumulated across sequential grid
-    # steps (the two-stage threadblock reduction of the CUDA kernel collapses
-    # to this on TPU).
+    # dgamma/dbeta — stage 2 of the CUDA kernel's two-stage threadblock
+    # reduction, with a tile-size-dependent strategy (both measured on
+    # v5e, 8192 rows):
+    # - big tiles (h<=~2k): one (8, H) partial PER grid step (row 0 live,
+    #   rows 1-7 zero for the sublane rule), summed by XLA outside —
+    #   avoids the revisited output block that stalls the pipeline's
+    #   output stage (h=1024: 90 -> 83 us/iter fwd+bwd);
+    # - small tiles (big h): accumulate into one revisited (1, H) block —
+    #   the per-step partial writes cost 8/tile of the stream bytes,
+    #   a 10% regression at tile 80 (h=4096: 801 -> 841 us with partials).
+    if accum_parts:
+        if has_w:
+            dw_ref[:] = jnp.concatenate(
+                [jnp.sum(dy * xhat, axis=0, keepdims=True),
+                 jnp.zeros((7, dy.shape[1]), jnp.float32)], axis=0)
+        if has_b:
+            db_ref[:] = jnp.concatenate(
+                [jnp.sum(dy, axis=0, keepdims=True),
+                 jnp.zeros((7, dy.shape[1]), jnp.float32)], axis=0)
+        return
     step = pl.program_id(0)
     if has_w:
         @pl.when(step == 0)
@@ -221,17 +239,28 @@ def _bwd_call(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
     in_specs.append(_stat_spec(tile))
     args.append(rstdp)
 
+    # partial-per-step writes cost 8/tile of the row streams: worth it
+    # only when tiles are big (see the kernel's strategy note)
+    accum_parts = tile >= 128
+    if accum_parts:
+        gw_spec = pl.BlockSpec((8, h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+        gw_shape = jax.ShapeDtypeStruct((grid * 8, h), jnp.float32)
+    else:
+        gw_spec = _full_spec(h)
+        gw_shape = jax.ShapeDtypeStruct((1, h), jnp.float32)
     out_shape = [jax.ShapeDtypeStruct((padded, h), x2d.dtype)]
     out_specs = [_row_spec(tile, h)]
     if has_w:
-        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
-        out_specs.append(_full_spec(h))
+        out_shape.append(gw_shape)
+        out_specs.append(gw_spec)
     if has_b:
-        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
-        out_specs.append(_full_spec(h))
+        out_shape.append(gw_shape)
+        out_specs.append(gw_spec)
 
     kernel = functools.partial(
-        _bwd_kernel, mode=mode, has_w=has_w, has_b=has_b
+        _bwd_kernel, mode=mode, has_w=has_w, has_b=has_b,
+        accum_parts=accum_parts,
     )
     outs = pl.pallas_call(
         kernel,
@@ -243,8 +272,8 @@ def _bwd_call(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
     )(*args)
     outs = list(outs)
     dx = outs.pop(0)[:rows]
-    dw = outs.pop(0).reshape(h) if has_w else None
-    db = outs.pop(0).reshape(h) if has_b else None
+    dw = outs.pop(0).sum(axis=0) if has_w else None
+    db = outs.pop(0).sum(axis=0) if has_b else None
     return dx, dw, db
 
 
