@@ -67,6 +67,13 @@ class GPTConfig:
     # (seq_dim=1 in this model's (b, s, h) layout); Column gathers /
     # Row reduce-scatters at the region edges. Requires seq % tp == 0.
     sequence_parallel: bool = False
+    # Long-context parallelism: the WHOLE model runs on a sequence shard
+    # (ids arrive (b, s/cp)) and attention is ring attention over the
+    # ``context`` mesh axis — no rank ever holds the full sequence or an
+    # (s, s) score tile. Composes with tp (heads still shard over
+    # ``model``). Mutually exclusive with sequence_parallel (different
+    # axes, different contracts).
+    context_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -162,6 +169,14 @@ def _ln(p, x, eps):
                                    x.shape[-1], eps).astype(x.dtype)
 
 
+def _split_qkv(q_k_v: jax.Array, hd: int):
+    """(b, s, 3*h_local) head-major -> three (b, nh_local, s, hd)."""
+    b, s, w = q_k_v.shape
+    nh_local = w // (3 * hd)
+    qkv = q_k_v.reshape(b, s, nh_local, 3, hd)
+    return (qkv[:, :, :, j].transpose(0, 2, 1, 3) for j in range(3))
+
+
 def _causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
                       rope_freqs: Optional[jax.Array]) -> jax.Array:
     """(b, s, 3*h_local) -> (b, s, h_local); heads derived from the local
@@ -173,26 +188,46 @@ def _causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
     ColumnParallelLinear sharding correct. A ``[Q | K | V]``-major layout
     would hand each rank slices of unrelated heads.
     """
-    b, s, w = q_k_v.shape
+    b, s, _ = q_k_v.shape
     hd = cfg.head_dim
-    nh_local = w // (3 * hd)
-    qkv = q_k_v.reshape(b, s, nh_local, 3, hd)
-    q, k, v = (qkv[:, :, :, j].transpose(0, 2, 1, 3) for j in range(3))
+    q, k, v = _split_qkv(q_k_v, hd)
     if rope_freqs is not None:
         q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs)
         k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs)
     ctx = flash_attention(q, k, v, causal=True,
                           softmax_scale=1.0 / math.sqrt(hd))
-    return ctx.transpose(0, 2, 1, 3).reshape(b, s, nh_local * hd)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)
+
+
+def _ring_causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
+                           rope_freqs: Optional[jax.Array]) -> jax.Array:
+    """Context-parallel attention: same head-major split, but q/k/v stay
+    sequence-sharded and the score/PV work rides the ``context``-axis
+    ring (``rope_freqs`` already sliced to this rank's global
+    positions)."""
+    from apex_tpu.transformer.context_parallel import ring_attention
+
+    b, s, _ = q_k_v.shape
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs)
+    ctx = ring_attention(q, k, v, causal=True,
+                         softmax_scale=1.0 / math.sqrt(hd))
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)
 
 
 def _block(lp, x, cfg, rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
-           dropout_rng=None):
-    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+           dropout_rng=None, ring=False):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x)).
+    ``ring`` is an execution-path choice, not config: the unsharded
+    golden model runs the same cfg with plain attention."""
+    attn = _ring_causal_attention if ring else _causal_attention
     with jax.named_scope("attention"):
-        att = _causal_attention(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
-                                                      cfg.layer_norm_eps)),
-                                cfg, rope_freqs)
+        att = attn(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
+                                         cfg.layer_norm_eps)),
+                   cfg, rope_freqs)
         att = out_fn(lp["out"], att)
         att = _maybe_dropout(att, cfg.hidden_dropout, dropout_rng, 0)
         x = x + att
@@ -218,12 +253,12 @@ def _rope_or_none(cfg: GPTConfig, s: int):
 
 
 def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
-                 dropout_rng):
+                 dropout_rng, ring=False):
     """Depth loop: lax.scan over the stacked layer leaves, optionally
     rematerialized per layer (``cfg.remat``)."""
     def block(lp, x, rng):
         return _block(lp, x, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
-                      dropout_rng=rng)
+                      dropout_rng=rng, ring=ring)
 
     if cfg.remat:
         block = jax.checkpoint(block)
@@ -268,6 +303,11 @@ class GPTModel:
             raise ValueError(
                 f"num_heads {cfg.num_heads} not divisible by tp {t} "
                 "(attention heads shard over the model axis)")
+        if cfg.sequence_parallel and cfg.context_parallel:
+            raise ValueError(
+                "sequence_parallel and context_parallel are mutually "
+                "exclusive (different axes, different activation "
+                "contracts)")
         sp = dict(sequence_parallel_enabled=cfg.sequence_parallel,
                   sequence_parallel_seq_dim=1)  # (b, s, h) layout
         self.qkv = tp.ColumnParallelLinear(h, 3 * h, gather_output=False,
@@ -299,6 +339,26 @@ class GPTModel:
         x = self.embed.apply(params["embedding"]["word"], input_ids)
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
+        if cfg.context_parallel:
+            # ids arrived (b, s/cp): positions and rotary angles are the
+            # GLOBAL ones for this rank's shard
+            cp_rank = lax.axis_index(ps.CONTEXT_AXIS)
+            if not cfg.use_rope:
+                pos = lax.dynamic_slice_in_dim(
+                    params["embedding"]["position"]["embedding"],
+                    cp_rank * s, s, 0)
+                x = x + pos.astype(x.dtype)[None]
+            freqs = _rope_or_none(
+                cfg, s * lax.axis_size(ps.CONTEXT_AXIS))
+            if freqs is not None:
+                freqs = lax.dynamic_slice_in_dim(freqs, cp_rank * s, s, 0)
+            if dropout_rng is not None:
+                dropout_rng = jax.random.fold_in(dropout_rng, cp_rank)
+            x = _scan_layers(x, params["layers"], cfg, freqs,
+                             self.qkv.apply, self.out.apply,
+                             self.fc1.apply, self.fc2.apply, dropout_rng,
+                             ring=True)
+            return _ln(params["final_ln"], x, cfg.layer_norm_eps)
         if not cfg.use_rope:
             pos = params["embedding"]["position"]["embedding"][:s]
             x = x + pos.astype(x.dtype)[None]
@@ -371,7 +431,15 @@ class GPTModel:
                 jnp.float32)
         else:
             logits = self.logits_local(params, hidden)
-        return vocab_parallel_cross_entropy(logits, labels).mean()
+        loss = vocab_parallel_cross_entropy(logits, labels).mean()
+        if self.cfg.context_parallel:
+            # per-token losses live on seq shards of equal size: the
+            # global mean is the mean of rank means. NOTE the trainer's
+            # closure: like DDP over the batch, each rank's AD yields
+            # d(local token mean)/dp — pmean the GRADS over the context
+            # axis after backward (see test_context_parallel_*).
+            loss = lax.pmean(loss, ps.CONTEXT_AXIS)
+        return loss
 
 
 # ---------------------------------------------------------------------------

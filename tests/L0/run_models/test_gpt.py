@@ -146,6 +146,50 @@ def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp):
         rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("use_rope,tp,cp", [
+    (False, 1, 8), (True, 1, 8), (False, 2, 4)])
+def test_context_parallel_matches_unsharded(use_rope, tp, cp):
+    """Long-context GPT: ids/labels sequence-sharded over the context
+    axis, ring attention inside — loss AND grads must match the
+    unsharded model (incl. composed with tp=2)."""
+    cfg = gpt_tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "use_rope": use_rope,
+                       "context_parallel": True})
+    ps.initialize_model_parallel(tensor_model_parallel_size_=tp,
+                                 context_parallel_size_=cp)
+    model = GPTModel(cfg, tp_size=tp)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    ids, labels = _data(cfg)
+
+    want_loss, want_grads = jax.value_and_grad(
+        lambda p: gpt_loss_unsharded(p, cfg, ids, labels))(params)
+
+    specs = model.partition_specs()
+    seq_sharded = P(None, ps.CONTEXT_AXIS)
+
+    def run(p, ids, labels):
+        loss, grads = jax.value_and_grad(model.loss, argnums=0)(
+            p, ids, labels)
+        # CP shards TOKENS the way DP shards the batch: each rank's AD
+        # yields d(local token mean)/dp, so the closure is the standard
+        # DDP one — pmean the grads over the context axis (psum alone
+        # measured exactly cp× too big)
+        grads = jax.tree.map(
+            lambda g: jax.lax.pmean(g, ps.CONTEXT_AXIS), grads)
+        return loss, grads
+
+    got_loss, got_grads = jax.jit(ps.shard_map(
+        run, in_specs=(specs, seq_sharded, seq_sharded),
+        out_specs=(P(), specs)))(params, ids, labels)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5),
+        got_grads, want_grads)
+
+
 def test_pipeline_param_roundrobin_layout():
     """chunk c lives at [lane c//pp, dev c%pp] — reference round-robin."""
     cfg = type(gpt_tiny())(**{**gpt_tiny().__dict__, "num_layers": 8})
