@@ -39,11 +39,21 @@ def m4n2_1d_mask(w: jax.Array, axis: int = 0) -> jax.Array:
 
 def _default_predicate(path: tuple, leaf: jax.Array) -> bool:
     """Prunable = float matrices with a 4-divisible contraction (first)
-    dim and both dims >= 16 (the reference skips embeddings/small/1-D
-    params via its whitelist; path is available for custom predicates)."""
-    return (leaf.ndim == 2 and leaf.shape[0] % 4 == 0
+    dim and both dims >= 16, EXCLUDING embedding-like leaves (the
+    reference whitelist only sparsifies Linear-like modules — a (vocab,
+    h) word table is a gather table, not a GEMM operand, and 2:4-pruning
+    it destroys token representations for zero sparse-MXU gain). The
+    path-name heuristic matches 'embed'/'embedding'/'lookup' anywhere in
+    the key path; models with unconventional naming should pass a custom
+    predicate."""
+    if not (leaf.ndim == 2 and leaf.shape[0] % 4 == 0
             and min(leaf.shape) >= 16
-            and jnp.issubdtype(leaf.dtype, jnp.floating))
+            and jnp.issubdtype(leaf.dtype, jnp.floating)):
+        return False
+    path_str = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path).lower()
+    return not any(tag in path_str
+                   for tag in ("embed", "embedding", "lookup"))
 
 
 def compute_sparse_masks(params: Any,

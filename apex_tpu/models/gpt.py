@@ -543,6 +543,22 @@ def gpt_pipeline_partition_specs(cfg: GPTConfig,
     }
 
 
+def accumulate_tied_word_grads(grads: Dict[str, Any]) -> Dict[str, Any]:
+    """Sum the two pipeline-layout copies of the tied word-table grad
+    (embed lookup + LM head) into BOTH slots so the copies take
+    identical updates and stay tied — Megatron's shared-embedding
+    allreduce (ref: ``megatron/model/language_model.py ::
+    Embedding`` shared-word-embeddings grad allreduce). Call after the
+    pipeline schedule (which already psums embed/head grads over pipe)
+    and before the optimizer step."""
+    grads = dict(grads)
+    tied = jax.tree.map(jnp.add, grads["embed"]["word"],
+                        grads["head"]["word"])
+    grads["embed"] = dict(grads["embed"], word=tied)
+    grads["head"] = dict(grads["head"], word=tied)
+    return grads
+
+
 def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
     """A ``PipelineModel`` over the TP block — runs inside shard_map over
     BOTH the pipe and model axes (tp×pp)."""
@@ -570,7 +586,12 @@ def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
         return x
 
     def stage_fn(stage_params, x):
-        freqs = _rope_or_none(cfg, x.shape[1])
+        # under SP the hidden travels seq-sharded (s/tp): rotary angles
+        # must span the GLOBAL sequence the Column gather reassembles
+        s = x.shape[1]
+        if cfg.sequence_parallel:
+            s *= ps.get_tensor_model_parallel_world_size()
+        freqs = _rope_or_none(cfg, s)
 
         def body(x, lp):
             return _block(lp, x, cfg, freqs,

@@ -27,8 +27,8 @@ sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
 
 from apex_tpu.contrib.optimizers import DistributedFusedAdam  # noqa: E402
 from apex_tpu.models.gpt import (  # noqa: E402
-    GPTConfig, GPTModel, gpt_pipeline_model, gpt_pipeline_partition_specs,
-    gpt_to_pipeline_params, init_gpt,
+    GPTConfig, GPTModel, accumulate_tied_word_grads, gpt_pipeline_model,
+    gpt_pipeline_partition_specs, gpt_to_pipeline_params, init_gpt,
 )
 from apex_tpu.optimizers import FusedAdam  # noqa: E402
 from apex_tpu.transformer import parallel_state as ps  # noqa: E402
@@ -107,14 +107,9 @@ def main():
         loss, grads = fwd_bwd(pipe_model, p, batch, num_microbatches=M)
         loss = lax.pmean(loss, ps.DATA_AXIS)
         # tied embedding: the pipeline layout holds the word table twice
-        # (embed lookup + LM head) and each copy gets a PARTIAL grad; sum
-        # them into BOTH slots so the copies take identical updates and
-        # stay tied (Megatron's shared-embedding allreduce)
-        grads = dict(grads)
-        tied = jax.tree.map(jnp.add, grads["embed"]["word"],
-                            grads["head"]["word"])
-        grads["embed"] = dict(grads["embed"], word=tied)
-        grads["head"] = dict(grads["head"], word=tied)
+        # (embed lookup + LM head); sum the partial grads so both copies
+        # take identical updates (Megatron's shared-embedding allreduce)
+        grads = accumulate_tied_word_grads(grads)
         # SP: LN/Row-bias grads are per-rank partials over the model axis
         grads = model.allreduce_sequence_parallel_grads(grads)
         if ns.use_distributed_optimizer:

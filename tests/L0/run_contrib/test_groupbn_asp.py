@@ -122,6 +122,17 @@ def test_mask_tree_predicate():
     assert masks["tiny"] is True                     # too small: sentinel
 
 
+def test_default_predicate_skips_embeddings():
+    """The reference whitelist never sparsifies embedding tables — the
+    default predicate must skip embedding-like leaves by path name even
+    when their shape qualifies."""
+    params = {"embed": {"word": {"embedding": jnp.ones((128, 64))}},
+              "decoder": {"w": jnp.ones((128, 64))}}
+    masks = compute_sparse_masks(params)
+    assert masks["embed"]["word"]["embedding"] is True   # skipped
+    assert np.asarray(masks["decoder"]["w"]).sum() == 128 * 32
+
+
 def test_wrapped_optimizer_keeps_sparsity():
     asp = ASP()
     params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 64))}

@@ -80,17 +80,21 @@ def test_tp1_runs_without_sharding_surprises():
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
-@pytest.mark.parametrize("pp,vpp,tp,sp", [
-    (2, None, 1, False), (4, None, 1, False), (2, 2, 1, False),
-    (2, None, 2, True)])
-def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp):
+@pytest.mark.parametrize("pp,vpp,tp,sp,rope", [
+    (2, None, 1, False, False), (4, None, 1, False, False),
+    (2, 2, 1, False, False), (2, None, 2, True, False),
+    (2, None, 2, True, True)])
+def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp, rope):
     """GPT through the collective pipeline schedules — loss parity with
     the unsharded model and grad parity for the stages (incl. the
-    tp=2 + sequence-parallel combination riding the pipe)."""
+    tp=2 + sequence-parallel combination riding the pipe, with and
+    without RoPE — the rotary table must span the GLOBAL sequence even
+    though stage_fn sees the seq-sharded hidden)."""
     from apex_tpu.transformer.pipeline_parallel import schedules
 
     cfg = gpt_tiny()
-    cfg = type(cfg)(**{**cfg.__dict__, "sequence_parallel": sp})
+    cfg = type(cfg)(**{**cfg.__dict__, "sequence_parallel": sp,
+                       "use_rope": rope})
     ps.initialize_model_parallel(
         tensor_model_parallel_size_=tp,
         pipeline_model_parallel_size_=pp,
