@@ -67,6 +67,7 @@ from jax import lax
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel import microbatches as mb_calc
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_backward_recv_backward,
     send_forward_recv_forward,
 )
 
@@ -168,6 +169,29 @@ def forward_backward_no_pipelining(
 # plain (non-interleaved) pipelining — 1F1B equivalent
 # ---------------------------------------------------------------------------
 
+def _mb_at(mbs: Pytree, i, M: int) -> Pytree:
+    """Dynamic microbatch slice (clipped; callers mask invalid ticks)."""
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, jnp.clip(i, 0, M - 1), 0,
+                                           keepdims=False), mbs)
+
+
+def _hidden_proto(model: PipelineModel, embed_p, mb0):
+    shape = jax.eval_shape(model.embed_fn, embed_p, mb0)
+    return jnp.zeros(shape.shape, shape.dtype)
+
+
+def _masked_axpy(acc: Pytree, upd: Pytree, valid) -> Pytree:
+    return jax.tree.map(
+        lambda a, b: a + jnp.where(valid, b, 0).astype(a.dtype), acc, upd)
+
+
+def _zeros_f32_like(tree: Pytree) -> Pytree:
+    return jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.promote_types(a.dtype,
+                                                       jnp.float32)), tree)
+
+
 def forward_backward_pipelining_without_interleaving(
     model: PipelineModel,
     params: Dict[str, Pytree],
@@ -181,59 +205,120 @@ def forward_backward_pipelining_without_interleaving(
 
     Call inside shard_map; ``params["stages"]`` leaves arrive as the
     local ``(1, ...)`` slice of the ``(pp, ...)`` stack.
+
+    **Memory discipline** (the schedule's reason to exist — ref:
+    ``deallocate_output_tensor`` + the warmup/steady/cooldown split).  The
+    backward is written INTO the tick by hand with ``jax.vjp`` rather
+    than differentiating the microbatch scan: tick ``t`` forwards
+    microbatch ``t - d`` AND backwards microbatch ``t - 2(pp-1) + d``
+    (1F1B steady state), with the forward's stage inputs kept in a
+    circular buffer of depth ``2*pp - 1`` — the live-activation bound is
+    therefore **O(pp × microbatch), independent of the global batch**,
+    and the scan itself is never differentiated so no per-tick residuals
+    accumulate (``tests/L0/run_transformer/test_pipeline_memory.py``
+    asserts the compiled peak temp memory is flat in M).  The backward
+    recomputes the stage forward from the saved input (the
+    activation-recompute discipline the reference pairs with 1F1B);
+    ``checkpoint_stages`` is accepted for API compatibility but the
+    recompute is inherent here.
+
+    Grad accumulation is fp32 regardless of param dtype (the schedule-
+    level ``gradient_accumulation_fusion`` analogue).
     """
+    del checkpoint_stages  # recompute-from-saved-input is inherent
     M = _num_microbatches(num_microbatches)
     mbs = split_batch_into_microbatches(batch, M)
     pp = lax.axis_size(ps.PIPE_AXIS)
     d = lax.axis_index(ps.PIPE_AXIS)
-    stage = _stage_apply(model, checkpoint_stages)
-    T = M + pp - 1
-
-    def compute_loss(p):
-        stage_p = jax.tree.map(lambda a: a[0], p["stages"])
-        # embed every microbatch up-front, one big MXU-friendly batch op
-        # (computed on all stages; only stage 0's copy is consumed)
-        xs = jax.vmap(model.embed_fn, in_axes=(None, 0))(p["embed"], mbs)
-
-        def tick(carry, t):
-            state, outs = carry
-            inject = lax.dynamic_index_in_dim(
-                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            x_in = jnp.where(d == 0, inject, state)
-            y = stage(stage_p, x_in)
-            # last stage records microbatch t-(pp-1) (garbage elsewhere /
-            # out-of-range ticks are masked, not clip-written)
-            slot = jnp.clip(t - (pp - 1), 0, M - 1)
-            valid = t >= pp - 1
-            old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
-            outs = lax.dynamic_update_index_in_dim(
-                outs, jnp.where(valid, y, old), slot, 0)
-            return (send_forward_recv_forward(y), outs), None
-
-        state0 = jnp.zeros_like(xs[0])
-        outs0 = jnp.zeros((M,) + state0.shape, state0.dtype)
-        (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(T))
-
-        losses = jax.vmap(model.loss_fn, in_axes=(None, 0, 0))(
-            p["head"], outs, mbs)
-        local = losses.mean().astype(jnp.float32)
-        # only the last stage computed real losses — mask the others.
-        # NOTE: differentiate the MASKED LOCAL value, not its psum: AD of a
-        # per-rank output with unit cotangent computes grad of the sum over
-        # ranks, and the mask makes that sum count the loss exactly once
-        # (a psum here would transpose to another psum and scale every
-        # gradient by pp).
-        return jnp.where(d == pp - 1, local, 0.0)
+    stage = model.stage_fn
+    stage_p = jax.tree.map(lambda a: a[0], params["stages"])
+    embed_p, head_p = params["embed"], params["head"]
+    state0 = _hidden_proto(model, embed_p, _mb_at(mbs, 0, M))
 
     if forward_only:
-        return lax.psum(compute_loss(params), ps.PIPE_AXIS), None
-    loss, grads = jax.value_and_grad(compute_loss)(params)
-    loss = lax.psum(loss, ps.PIPE_AXIS)
-    grads = dict(grads)
-    # embed grads live on stage 0 (injection), head grads on the last
-    # stage (loss mask): replicate both, ref's embedding-group allreduce
-    grads["embed"] = lax.psum(grads["embed"], ps.PIPE_AXIS)
-    grads["head"] = lax.psum(grads["head"], ps.PIPE_AXIS)
+        T = M + pp - 1
+
+        def tick_f(carry, t):
+            state, acc = carry
+            x_in = jnp.where(d == 0,
+                             model.embed_fn(embed_p, _mb_at(mbs, t, M)),
+                             state)
+            y = stage(stage_p, x_in)
+            m_l = t - (pp - 1)
+            l = model.loss_fn(head_p, y, _mb_at(mbs, m_l, M))
+            acc = acc + jnp.where((m_l >= 0) & (d == pp - 1),
+                                  l.astype(jnp.float32), 0.0)
+            return (send_forward_recv_forward(y), acc), None
+
+        (_, total), _ = lax.scan(tick_f, (state0, jnp.float32(0)),
+                                 jnp.arange(T))
+        return lax.psum(total / M, ps.PIPE_AXIS), None
+
+    R = 2 * pp - 1          # residual-ring depth: max input residency
+    T = M + 2 * (pp - 1)    # fill + steady 1F1B + drain
+
+    def tick(carry, t):
+        state, cot, ring, g_stage, g_embed, g_head, loss_acc = carry
+
+        # -- forward half: stage d forwards microbatch t - d ------------
+        m_f = t - d
+        fwd_valid = (m_f >= 0) & (m_f < M)
+        x_in = jnp.where(d == 0,
+                         model.embed_fn(embed_p, _mb_at(mbs, t, M)),
+                         state)
+        y = stage(stage_p, x_in)
+        slot_f = jnp.mod(m_f, R)
+        old = lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(fwd_valid, x_in, old), slot_f, 0)
+
+        # -- loss half: last stage seeds the backward from this tick's y
+        m_l = t - (pp - 1)
+        loss_valid = (m_l >= 0) & (m_l < M)
+        mb_l = _mb_at(mbs, m_l, M)
+        l, loss_vjp = jax.vjp(
+            lambda hp, yy: model.loss_fn(hp, yy, mb_l), head_p, y)
+        seed = jnp.where(loss_valid & (d == pp - 1), 1.0 / M, 0.0)
+        dhead, dy_loss = loss_vjp(seed.astype(l.dtype))
+        loss_acc = loss_acc + jnp.where(loss_valid & (d == pp - 1),
+                                        l.astype(jnp.float32), 0.0)
+        g_head = _masked_axpy(g_head, dhead, True)  # seed already masks
+
+        # -- backward half: stage d backwards microbatch t - 2(pp-1) + d
+        m_b = t - 2 * (pp - 1) + d
+        bwd_valid = (m_b >= 0) & (m_b < M)
+        g_in = jnp.where(d == pp - 1, dy_loss, cot)
+        x_saved = lax.dynamic_index_in_dim(ring, jnp.mod(m_b, R), 0,
+                                           keepdims=False)
+        _, stage_vjp = jax.vjp(stage, stage_p, x_saved)
+        dstage, dx = stage_vjp(g_in)
+        g_stage = _masked_axpy(g_stage, dstage, bwd_valid)
+        mb_b = _mb_at(mbs, m_b, M)
+        _, embed_vjp = jax.vjp(lambda ep: model.embed_fn(ep, mb_b),
+                               embed_p)
+        (dembed,) = embed_vjp(dx)
+        g_embed = _masked_axpy(g_embed, dembed, bwd_valid & (d == 0))
+
+        return (send_forward_recv_forward(y),
+                send_backward_recv_backward(dx),
+                ring, g_stage, g_embed, g_head, loss_acc), None
+
+    carry0 = (state0, jnp.zeros_like(state0),
+              jnp.zeros((R,) + state0.shape, state0.dtype),
+              _zeros_f32_like(stage_p), _zeros_f32_like(embed_p),
+              _zeros_f32_like(head_p), jnp.float32(0))
+    (_, _, _, g_stage, g_embed, g_head, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(loss_acc, ps.PIPE_AXIS) / M
+    grads = {
+        "stages": jax.tree.map(lambda a: a[None], g_stage),
+        # embed grads live on stage 0 (injection), head grads on the last
+        # stage (loss seed): replicate both — the analogue of the
+        # reference's embedding-group allreduce
+        "embed": lax.psum(g_embed, ps.PIPE_AXIS),
+        "head": lax.psum(g_head, ps.PIPE_AXIS),
+    }
     return loss, grads
 
 

@@ -1,0 +1,95 @@
+"""Pipeline schedule live-memory bound (SURVEY §2a: 1F1B exists to bound
+live activations at O(pp) microbatches; ref ``deallocate_output_tensor``
+discipline).
+
+The collective 1F1B writes its backward into the tick with ``jax.vjp``
+and keeps stage inputs in a depth-``2pp-1`` ring, so per-stage live
+activation memory must be **O(pp x microbatch), independent of the
+number of microbatches M**. The CPU backend reports no buffer-assignment
+stats (``memory_analysis().temp_size_in_bytes`` is 0), so the bound is
+asserted on the optimized HLO: the largest *floating-point* buffer in
+the compiled module must not grow with M at fixed microbatch size.
+(The integer token batch is the program input and legitimately scales
+with M; activations are floating point, so restricting to fp dtypes
+isolates them.)
+"""
+
+import re
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_without_interleaving,
+)
+
+import importlib.util as _ilu
+import os as _os
+
+_spec = _ilu.spec_from_file_location(
+    "_pp_rig", _os.path.join(_os.path.dirname(__file__),
+                             "test_pipeline_parallel.py"))
+_rig = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_rig)
+MODEL, _batch, _init = _rig.MODEL, _rig._batch, _rig._init
+
+_FP_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1}
+_SHAPE_RE = re.compile(r"\b(f64|f32|bf16|f16)\[([0-9,]*)\]")
+
+
+def _max_fp_buffer_bytes(hlo_text: str) -> int:
+    best = 0
+    for dtype, dims in _SHAPE_RE.findall(hlo_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * _FP_BYTES[dtype])
+    return best
+
+
+def _compiled_hlo(pp: int, n_mb: int, mb_size: int = 2) -> str:
+    params = _init(jax.random.PRNGKey(0), pp)
+    batch = _batch(jax.random.PRNGKey(1), mb_size * n_mb)
+    fn = jax.jit(ps.shard_map(
+        lambda p, b: forward_backward_pipelining_without_interleaving(
+            MODEL, p, b, num_microbatches=n_mb),
+        in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS), "head": P()},
+                  P()),
+        out_specs=(P(), {"embed": P(), "stages": P(ps.PIPE_AXIS),
+                         "head": P()}),
+    ))
+    return fn.lower(params, batch).compile().as_text()
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_live_activation_memory_flat_in_num_microbatches(pp):
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+    small = _max_fp_buffer_bytes(_compiled_hlo(pp, n_mb=4))
+    big = _max_fp_buffer_bytes(_compiled_hlo(pp, n_mb=16))
+    # 4x the microbatches must not grow any activation buffer: the ring
+    # (2pp-1 stage inputs) and the grad accumulators bound live memory.
+    assert big <= small, (small, big)
+
+
+def test_forward_only_memory_flat_in_num_microbatches():
+    pp = 2
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+
+    def hlo(n_mb):
+        params = _init(jax.random.PRNGKey(0), pp)
+        batch = _batch(jax.random.PRNGKey(1), 2 * n_mb)
+        fn = jax.jit(ps.shard_map(
+            lambda p, b: forward_backward_pipelining_without_interleaving(
+                MODEL, p, b, num_microbatches=n_mb, forward_only=True)[0],
+            in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS),
+                       "head": P()}, P()),
+            out_specs=P(),
+        ))
+        return fn.lower(params, batch).compile().as_text()
+
+    assert _max_fp_buffer_bytes(hlo(16)) <= _max_fp_buffer_bytes(hlo(4))
