@@ -47,7 +47,10 @@ def tol(dtype):
         rtol=2e-5, atol=2e-5)
 
 
-SHAPES = [((32, 256), 256), ((4, 17, 384), 384), ((3, 1024), 1024)]
+SHAPES = [((32, 256), 256), ((4, 17, 384), 384), ((3, 1024), 1024),
+          # large H exercises the column-split backward (incl. a hidden
+          # size that doesn't divide the column tile)
+          ((12, 4096), 4096), ((9, 2816), 2816)]
 
 
 @pytest.mark.parametrize("shape,h", SHAPES)
