@@ -74,6 +74,11 @@ class GPTConfig:
     # ``model``). Mutually exclusive with sequence_parallel (different
     # axes, different contracts).
     context_parallel: bool = False
+    # per-layer fp32 wgrad emission (the gradient_accumulation_fusion
+    # analogue, ref fused_weight_gradient_mlp_cuda): with fp32 master
+    # weights + bf16 compute, TP linear wgrads leave each layer at fp32
+    # with no bf16 round-trip, so microbatch accumulation keeps low bits
+    gradient_accumulation_fusion: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -309,7 +314,9 @@ class GPTModel:
                 "exclusive (different axes, different activation "
                 "contracts)")
         sp = dict(sequence_parallel_enabled=cfg.sequence_parallel,
-                  sequence_parallel_seq_dim=1)  # (b, s, h) layout
+                  sequence_parallel_seq_dim=1,  # (b, s, h) layout
+                  gradient_accumulation_fusion=
+                  cfg.gradient_accumulation_fusion)
         self.qkv = tp.ColumnParallelLinear(h, 3 * h, gather_output=False,
                                            tp_size=tp_size, **sp)
         self.out = tp.RowParallelLinear(h, h, input_is_parallel=True,

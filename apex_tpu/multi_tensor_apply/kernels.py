@@ -358,6 +358,143 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
     return p_new, m_new[:rows], v_new[:rows]
 
 
+# ---------------------------------------------------------------------------
+# Adagrad — ref csrc/multi_tensor_adagrad.cu
+# ---------------------------------------------------------------------------
+
+def _adagrad_kernel(sc_ref, g_ref, p_ref, s_ref, p_out, s_out):
+    lr = sc_ref[0, 0]
+    eps = sc_ref[0, 1]
+    wd = sc_ref[0, 2]
+    adagrad_w = sc_ref[0, 3]   # 1.0 => decoupled decay, 0.0 => L2 into grad
+    grad_scale = sc_ref[0, 4]
+
+    g = g_ref[:].astype(jnp.float32) * grad_scale
+    p = p_ref[:]
+    s = s_ref[:]
+
+    g = g + (1.0 - adagrad_w) * wd * p
+    s = s + g * g
+    u = g / (jnp.sqrt(s) + eps) + adagrad_w * wd * p
+    p_out[:] = p - lr * u
+    s_out[:] = s
+
+
+def flat_adagrad(grads: jax.Array, params: jax.Array, gsum: jax.Array,
+                 *, lr, eps: float, weight_decay,
+                 adagrad_w_mode: bool = False, grad_scale=1.0,
+                 interpret: Optional[bool] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """One fused Adagrad step over flat fp32 buffers (ref:
+    ``csrc/multi_tensor_adagrad.cu``); ``params``/``gsum`` alias in
+    place."""
+    rows = params.shape[0]
+    gp, pp, sp = (_pad_to_block(b) for b in (grads, params, gsum))
+    n_tiles = pp.shape[0] // BLOCK_ROWS
+    sc = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(eps),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.float32(1.0 if adagrad_w_mode else 0.0),
+        jnp.asarray(grad_scale, jnp.float32),
+    ]).reshape(1, 5)
+    p_new, s_new = pl.pallas_call(
+        _adagrad_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec()] + [_tile_spec()] * 3,
+        out_specs=[_tile_spec()] * 2,
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        input_output_aliases={2: 0, 3: 1},
+        interpret=pallas_interpret(interpret),
+    )(sc, gp, pp, sp)
+    return p_new[:rows], s_new[:rows]
+
+
+# ---------------------------------------------------------------------------
+# NovoGrad — ref csrc/multi_tensor_novograd.cu (per-tensor second moment)
+# ---------------------------------------------------------------------------
+
+def _novograd_kernel(sc_ref, denom_ref, g_ref, p_ref, m_ref, p_out, m_out):
+    lr = sc_ref[0, 0]
+    b1 = sc_ref[0, 1]
+    beta3 = sc_ref[0, 2]       # 1-b1 (grad averaging) or 1.0
+    wd = sc_ref[0, 3]
+    c1 = sc_ref[0, 4]          # 1 - b1^t
+    reg_inside = sc_ref[0, 5]  # 1.0 => wd folded into the moment
+    grad_scale = sc_ref[0, 6]
+
+    g = g_ref[:].astype(jnp.float32) * grad_scale
+    p = p_ref[:]
+    m = m_ref[:]
+
+    gn = g / denom_ref[:]      # per-row broadcast of the per-tensor denom
+    gn = gn + reg_inside * wd * p
+    m = b1 * m + beta3 * gn
+    u = m / c1 + (1.0 - reg_inside) * wd * p
+    p_out[:] = p - lr * u
+    m_out[:] = m
+
+
+def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
+                  v: jax.Array, tile_ids, *, lr, beta1: float, beta2: float,
+                  eps: float, step, weight_decay, num_tensors: int,
+                  grad_averaging: bool = True, bias_correction: bool = True,
+                  reg_inside_moment: bool = False, init_zero: bool = False,
+                  grad_scale=1.0, interpret: Optional[bool] = None
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused NovoGrad step over flat fp32 buffers. NovoGrad's second
+    moment is ONE scalar per tensor (the layer-wise EMA of ||g||², ref
+    ``multi_tensor_novograd.cu``), so ``v`` is a ``(num_tensors,)`` fp32
+    vector: the per-sub-tile ||g||² partials come from one l2 pre-pass
+    (the same two-stage reduction LAMB uses), the tiny v-EMA update is
+    XLA, and the elementwise moment/param update is one Pallas pass with
+    the per-tensor denominator broadcast in as a ``(rows, 1)`` column.
+    ``tile_ids`` is ``FlatSpec.tile_tensor_ids(8)``.
+    """
+    rows = params.shape[0]
+    gs = jnp.asarray(grad_scale, jnp.float32)
+    ids = jnp.asarray(tile_ids, jnp.int32)
+    n_sub = rows // _SUB
+    gsq = jax.ops.segment_sum(
+        flat_l2norm_partials(grads, interpret)[:n_sub], ids,
+        num_segments=num_tensors) * gs * gs
+    b2 = jnp.float32(beta2)
+    first = jnp.asarray(step, jnp.int32) <= 1
+    ema = b2 * v + (1.0 - b2) * gsq
+    v_new = ema if init_zero else jnp.where(first, gsq, ema)
+
+    t = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        c1 = 1.0 - jnp.float32(beta1) ** t
+        c2 = 1.0 - b2 ** t
+    else:
+        c1 = c2 = jnp.float32(1.0)
+    denom = jnp.sqrt(v_new / c2) + jnp.float32(eps)
+    row_denom = jnp.repeat(denom[ids], _SUB)[:, None]  # (rows, 1)
+    row_denom = _pad_to_block(row_denom)
+    row_denom = jnp.where(row_denom == 0, 1.0, row_denom)  # block-pad rows
+
+    gp, pp, mp = (_pad_to_block(b) for b in (grads, params, m))
+    n_tiles = pp.shape[0] // BLOCK_ROWS
+    sc = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(beta1),
+        jnp.float32(1.0 - beta1 if grad_averaging else 1.0),
+        jnp.asarray(weight_decay, jnp.float32), c1,
+        jnp.float32(1.0 if reg_inside_moment else 0.0), gs,
+    ]).reshape(1, 7)
+    denom_spec = pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    p_new, m_new = pl.pallas_call(
+        _novograd_kernel,
+        grid=(n_tiles,),
+        in_specs=[_smem_spec(), denom_spec] + [_tile_spec()] * 3,
+        out_specs=[_tile_spec()] * 2,
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        input_output_aliases={3: 0, 4: 1},
+        interpret=pallas_interpret(interpret),
+    )(sc, row_denom, gp, pp, mp)
+    return p_new[:rows], m_new[:rows], v_new
+
+
 def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
               *, lr, beta1: float, beta2: float, eps: float, step,
               weight_decay, adam_w_mode: bool = True,

@@ -39,6 +39,13 @@ Shape = Union[int, Sequence[int]]
 
 _LANE = 128
 _SUBLANE = 8
+
+
+def _dimsem(*sem):
+    """Grid dimension semantics: 'parallel' lets Mosaic pipeline blocks
+    without ordering constraints (measured ~12% on the flash kernels);
+    accumulating grids must stay 'arbitrary'."""
+    return pltpu.CompilerParams(dimension_semantics=sem)
 # VMEM working-set budget for choosing the row tile. A tile touches ~6 fp32
 # row-blocks (x, y, dy, dx, xhat temp, wdy temp) at H columns each.
 _VMEM_BUDGET = 8 * 1024 * 1024
@@ -312,6 +319,7 @@ def _bwd_call_colsplit(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=_dimsem("arbitrary", "arbitrary"),
         interpret=pallas_interpret(interpret),
     )(*args)
     outs = list(outs)
@@ -350,6 +358,7 @@ def _bwd_call_colsplit(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
         in_specs=in_specs2,
         out_specs=blk2,
         out_shape=jax.ShapeDtypeStruct((padded, h_p), x2d.dtype),
+        compiler_params=_dimsem("parallel", "parallel"),
         interpret=pallas_interpret(interpret),
     )(*args2)
     return dx[:rows, :h], dw, db
@@ -399,6 +408,7 @@ def _fwd_call(x2d, w, b, mode, eps, interpret):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(*args)
     outs = [o[:rows] for o in outs]
@@ -468,6 +478,7 @@ def _bwd_call(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=_dimsem("arbitrary"),
         interpret=pallas_interpret(interpret),
     )(*args)
     outs = list(outs)

@@ -47,3 +47,24 @@ def tree_unzip(out: Any, n: int) -> Tuple[Any, ...]:
     return tuple(
         jax.tree.map(lambda o, i=i: o[i], out, is_leaf=is_tup)
         for i in range(n))
+
+
+def flat_layout(cache: dict, params: Any):
+    """Cached flat-buffer layout for the ``use_flat_kernel`` paths.
+
+    Returns ``(leaves, treedef, spec, tile_ids)``. Keyed by
+    ``(treedef, shapes, dtypes)`` — one optimizer instance may serve
+    several param trees, and same-structure trees with different leaf
+    shapes must not share a FlatSpec. ``tile_ids`` is
+    ``spec.tile_tensor_ids(8)``, computed once per layout (used by the
+    per-tensor reductions of LAMB/NovoGrad; harmless elsewhere).
+    """
+    from apex_tpu.multi_tensor_apply import flatten as _flatten
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    key = (treedef, tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
+    ent = cache.get(key)
+    if ent is None:
+        spec = _flatten.make_spec(leaves)
+        ent = cache[key] = (spec, spec.tile_tensor_ids(8))
+    return leaves, treedef, ent[0], ent[1]

@@ -1,12 +1,21 @@
 """FusedAdagrad — ref ``apex/optimizers/fused_adagrad.py``
-(kernel: ``csrc/multi_tensor_adagrad.cu``)."""
+(kernel: ``csrc/multi_tensor_adagrad.cu``).
+
+``use_flat_kernel=True`` packs params/state into ``(rows, 128)`` flat
+fp32 buffers and updates them with ONE in-place Pallas pass
+(``kernels.flat_adagrad``) — the one-fused-pass-per-step property of the
+CUDA multi-tensor kernel; see ``FusedAdam`` for when the flat path pays
+off (many small tensors)."""
 
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.multi_tensor_apply import flatten as _flatten
+from apex_tpu.multi_tensor_apply import kernels as _kernels
 from apex_tpu.optimizers._common import (
+    flat_layout,
     f32, select_finite, tree_unzip, tree_zeros_f32,
 )
 
@@ -18,15 +27,22 @@ class AdagradState(NamedTuple):
 
 class FusedAdagrad:
     def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
-                 weight_decay: float = 0.0, adagrad_w_mode: bool = False):
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False,
+                 *, use_flat_kernel: bool = False):
         self.lr = lr
         self.eps = eps
         self.weight_decay = weight_decay
         self.adagrad_w_mode = adagrad_w_mode
+        self.use_flat_kernel = use_flat_kernel
+        self._specs = {}
 
     def init(self, params: Any) -> AdagradState:
-        return AdagradState(step=jnp.zeros((), jnp.int32),
-                            sum=tree_zeros_f32(params))
+        step = jnp.zeros((), jnp.int32)
+        if self.use_flat_kernel:
+            leaves, _, spec, _ = flat_layout(self._specs, params)
+            buf, _ = _flatten.flatten_tensors(leaves, spec)
+            return AdagradState(step=step, sum=jnp.zeros_like(buf))
+        return AdagradState(step=step, sum=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: AdagradState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
@@ -39,6 +55,22 @@ class FusedAdagrad:
         gs = f32(grad_scale)
         eps = f32(self.eps)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
+
+        if self.use_flat_kernel:
+            leaves, treedef, spec, _ = flat_layout(self._specs, params)
+            gbuf, _ = _flatten.flatten_tensors(
+                jax.tree_util.tree_leaves(grads), spec)
+            pbuf, _ = _flatten.flatten_tensors(leaves, spec)
+            p_new, s_new = _kernels.flat_adagrad(
+                gbuf, pbuf, state.sum, lr=lr, eps=self.eps,
+                weight_decay=wd, adagrad_w_mode=self.adagrad_w_mode,
+                grad_scale=gs)
+            new_params = jax.tree_util.tree_unflatten(
+                treedef, _flatten.unflatten_tensors(p_new, spec))
+            new_state = AdagradState(step=state.step + 1, sum=s_new)
+            new_params = select_finite(found_inf, new_params, params)
+            new_state = select_finite(found_inf, new_state, state)
+            return new_params, new_state
 
         def upd(g, p, s):
             g = g.astype(jnp.float32) * gs

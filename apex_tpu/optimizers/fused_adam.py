@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from apex_tpu.multi_tensor_apply import flatten as _flatten
 from apex_tpu.multi_tensor_apply import kernels as _kernels
 from apex_tpu.optimizers._common import (
+    flat_layout,
     f32, select_finite, tree_unzip, tree_zeros_f32,
 )
 
@@ -51,18 +52,11 @@ class FusedAdam:
         # several param trees (init called more than once)
         self._specs = {}
 
-    @staticmethod
-    def _layout_key(leaves, treedef):
-        # treedef alone does not capture leaf shapes — same-structure trees
-        # with different shapes must not share a FlatSpec
-        return (treedef, tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
-
     def init(self, params: Any) -> AdamState:
         step = jnp.zeros((), jnp.int32)
         if self.use_flat_kernel:
-            leaves, treedef = jax.tree_util.tree_flatten(params)
-            buf, spec = _flatten.flatten_tensors(leaves, dtype=jnp.float32)
-            self._specs[self._layout_key(leaves, treedef)] = spec
+            leaves, _, spec, _ = flat_layout(self._specs, params)
+            buf, _ = _flatten.flatten_tensors(leaves, spec)
             return AdamState(step=step, m=jnp.zeros_like(buf),
                              v=jnp.zeros_like(buf))
         return AdamState(step=step, m=tree_zeros_f32(params),
@@ -129,11 +123,7 @@ class FusedAdam:
         return new_params, AdamState(step=t, m=new_m, v=new_v)
 
     def _flat_step(self, grads, params, state, lr, wd, t, grad_scale):
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = self._layout_key(leaves, treedef)
-        spec = self._specs.get(key)
-        if spec is None:
-            spec = self._specs[key] = _flatten.make_spec(leaves)
+        leaves, treedef, spec, _ = flat_layout(self._specs, params)
         gbuf, _ = _flatten.flatten_tensors(
             jax.tree_util.tree_leaves(grads), spec)
         pbuf, _ = _flatten.flatten_tensors(leaves, spec)

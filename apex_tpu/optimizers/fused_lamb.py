@@ -51,16 +51,9 @@ class FusedLAMB:
         self._specs = {}
 
     def _layout(self, params):
-        from apex_tpu.multi_tensor_apply import flatten as _flatten
+        from apex_tpu.optimizers._common import flat_layout
 
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = (treedef,
-               tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
-        cached = self._specs.get(key)
-        if cached is None:
-            spec = _flatten.make_spec(leaves)
-            cached = self._specs[key] = (spec, spec.tile_tensor_ids(8))
-        return leaves, treedef, cached[0], cached[1]
+        return flat_layout(self._specs, params)
 
     def init(self, params: Any) -> LambState:
         step = jnp.zeros((), jnp.int32)

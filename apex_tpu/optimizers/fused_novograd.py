@@ -3,14 +3,25 @@
 
 NovoGrad keeps the second moment as ONE scalar per tensor (the layer-wise
 EMA of ||g||²), so ``v`` here is a pytree of fp32 scalars. First step seeds
-``v`` with ||g||² unless ``init_zero``."""
+``v`` with ||g||² unless ``init_zero``.
+
+``use_flat_kernel=True`` runs the step on packed ``(rows, 128)`` flat
+fp32 buffers (``kernels.flat_novograd``): one l2 pre-pass for the
+per-tensor ||g||² (the LAMB-style two-stage reduction over
+``tile_tensor_ids``), then ONE in-place Pallas pass for the
+moment/param update — the one-fused-pass-per-step property of
+``multi_tensor_novograd.cu``. ``v`` is then a ``(num_tensors,)``
+vector."""
 
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.multi_tensor_apply import flatten as _flatten
+from apex_tpu.multi_tensor_apply import kernels as _kernels
 from apex_tpu.optimizers._common import (
+    flat_layout,
     f32, select_finite, tree_unzip, tree_zeros_f32,
 )
 
@@ -27,7 +38,8 @@ class FusedNovoGrad:
                  weight_decay: float = 0.0, amsgrad: bool = False,
                  reg_inside_moment: bool = False, grad_averaging: bool = True,
                  norm_type: int = 2, init_zero: bool = False,
-                 bias_correction: bool = True):
+                 bias_correction: bool = True, *,
+                 use_flat_kernel: bool = False):
         if amsgrad:
             raise RuntimeError(
                 "FusedNovoGrad does not support the AMSGrad variant.")
@@ -41,10 +53,19 @@ class FusedNovoGrad:
         self.grad_averaging = grad_averaging
         self.init_zero = init_zero
         self.bias_correction = bias_correction
+        self.use_flat_kernel = use_flat_kernel
+        self._specs = {}
 
     def init(self, params: Any) -> NovoGradState:
+        step = jnp.zeros((), jnp.int32)
+        if self.use_flat_kernel:
+            leaves, _, spec, _ = flat_layout(self._specs, params)
+            buf, _ = _flatten.flatten_tensors(leaves, spec)
+            return NovoGradState(
+                step=step, m=jnp.zeros_like(buf),
+                v=jnp.zeros((spec.num_tensors,), jnp.float32))
         return NovoGradState(
-            step=jnp.zeros((), jnp.int32),
+            step=step,
             m=tree_zeros_f32(params),
             v=jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params))
 
@@ -57,9 +78,32 @@ class FusedNovoGrad:
         DIVIDES — invert when porting. See ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
-        b1, b2, eps = f32(self.beta1), f32(self.beta2), f32(self.eps)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         t = state.step + 1
+
+        if self.use_flat_kernel:
+            leaves, treedef, spec, tile_ids = flat_layout(self._specs,
+                                                          params)
+            gbuf, _ = _flatten.flatten_tensors(
+                jax.tree_util.tree_leaves(grads), spec)
+            pbuf, _ = _flatten.flatten_tensors(leaves, spec)
+            p_new, m_new, v_new = _kernels.flat_novograd(
+                gbuf, pbuf, state.m, state.v,
+                tile_ids, lr=lr, beta1=self.beta1,
+                beta2=self.beta2, eps=self.eps, step=t, weight_decay=wd,
+                num_tensors=spec.num_tensors,
+                grad_averaging=self.grad_averaging,
+                bias_correction=self.bias_correction,
+                reg_inside_moment=self.reg_inside_moment,
+                init_zero=self.init_zero, grad_scale=gs)
+            new_params = jax.tree_util.tree_unflatten(
+                treedef, _flatten.unflatten_tensors(p_new, spec))
+            new_state = NovoGradState(step=t, m=m_new, v=v_new)
+            new_params = select_finite(found_inf, new_params, params)
+            new_state = select_finite(found_inf, new_state, state)
+            return new_params, new_state
+
+        b1, b2, eps = f32(self.beta1), f32(self.beta2), f32(self.eps)
         tf = t.astype(jnp.float32)
         first = (state.step == 0)
         beta3 = 1.0 - b1 if self.grad_averaging else jnp.float32(1.0)

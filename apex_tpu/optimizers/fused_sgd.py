@@ -39,14 +39,9 @@ class FusedSGD:
         self._specs = {}
 
     def _layout(self, params):
-        from apex_tpu.multi_tensor_apply import flatten as _flatten
+        from apex_tpu.optimizers._common import flat_layout
 
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = (treedef,
-               tuple((l.shape, jnp.dtype(l.dtype)) for l in leaves))
-        spec = self._specs.get(key)
-        if spec is None:
-            spec = self._specs[key] = _flatten.make_spec(leaves)
+        leaves, treedef, spec, _ = flat_layout(self._specs, params)
         return leaves, treedef, spec
 
     def init(self, params: Any) -> SGDState:

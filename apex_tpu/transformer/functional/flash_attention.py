@@ -56,12 +56,27 @@ def _causal_live(qt, kt, bq, bk):
     return kt * bk <= (qt + 1) * bq - 1
 
 
-# Causal kernels tile at <=256 (vs 512 dense): at seq 2048 the live-tile
-# fraction drops from 10/16 to 36/64, and the measured v5e win of the
-# extra skipping outweighs the smaller matmuls.
-_CAUSAL_MAX_BLOCK = 256
-_CAUSAL_SKIP = True   # trace-time toggle (perf experiments)
-_CAUSAL_CLAMP = True  # clamp index maps of skipped tiles (perf toggle)
+# Causal tile-skipping toggles. Measured on v5e (seq 2048, d 64, fwd+bwd,
+# several same-process A/B sweeps; cross-process numbers drift +-20% with
+# relay conditions): gating whole tiles behind pl.when costs MORE than the
+# skipped matmuls save (the kernels are VPU-bound, and the per-tile
+# control flow defeats Mosaic's copy/compute overlap), and index-map
+# clamping adds further cost. The win that did land is the mask-free
+# interior-tile path (_needs_mask). Defaults reflect the measurements;
+# the toggles remain for re-tuning on other TPU generations.
+_CAUSAL_MAX_BLOCK = 512
+_CAUSAL_SKIP = False
+_CAUSAL_CLAMP = False
+_DIM_SEMANTICS = True
+
+
+def _cparams():
+    """(batch*heads, outer, inner-reduction) -> the first two grid dims
+    are parallel, the innermost accumulates into scratch."""
+    if not _DIM_SEMANTICS:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _hash_keep(qpos, kpos, head, seed_lo, seed_hi, rate):
@@ -410,6 +425,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32)],
+        compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
     )(sc, sd, q3, k3, v3, m3)
     out = o[:, :sq, :d].reshape(b, h, sq, d)
@@ -448,6 +464,7 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
         out_specs=_qkv_spec(bq, d_p),
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
+        compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
     )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
 
@@ -478,6 +495,7 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
                    jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype)),
         scratch_shapes=[pltpu.VMEM((bk, d_p), jnp.float32),
                         pltpu.VMEM((bk, d_p), jnp.float32)],
+        compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
     )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
 

@@ -8,6 +8,7 @@ from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
+    linear_with_grad_accumulation,
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     copy_to_tensor_model_parallel_region,

@@ -40,6 +40,48 @@ def _init_kernel(key, shape, dtype):
     return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
 
 
+@jax.custom_vjp
+def linear_with_grad_accumulation(x, kernel):
+    """``x @ kernel`` whose weight gradient leaves the layer in the
+    KERNEL's dtype with fp32 GEMM accumulation and NO low-precision
+    round-trip — the ``gradient_accumulation_fusion`` analogue (ref:
+    ``fused_weight_gradient_mlp_cuda`` accumulating wgrads straight into
+    fp32 ``main_grad`` buffers; consumer ``tensor_parallel/layers.py ::
+    linear_with_grad_accumulation_and_async_allreduce``).
+
+    With fp32 master weights and bf16 activations (amp O2), plain AD
+    computes the wgrad GEMM, casts the cotangent DOWN to bf16 (the
+    compute dtype at the cast site), then widens it again when it meets
+    the fp32 accumulator — dropping the low bits every microbatch. Here
+    the wgrad is emitted at fp32 directly, so any downstream accumulation
+    (``lax.scan`` carry, user microbatch loop) stays exact; the "fusion"
+    is XLA folding the widening into the bwd GEMM epilogue. ``kernel``
+    should be fp32 for the property to bite (with a bf16 kernel the
+    cotangent must match bf16 and nothing is gained, same as the
+    reference's requirement that ``main_grad`` buffers exist).
+    """
+    return jnp.dot(x, kernel.astype(x.dtype))
+
+
+def _lga_fwd(x, kernel):
+    return linear_with_grad_accumulation(x, kernel), (x, kernel)
+
+
+def _lga_bwd(res, dy):
+    x, kernel = res
+    batch_dims = tuple(range(x.ndim - 1))
+    dx = jax.lax.dot_general(
+        dy, kernel.astype(dy.dtype), (((dy.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    dk = jax.lax.dot_general(
+        x, dy, ((batch_dims, batch_dims), ((), ())),
+        preferred_element_type=jnp.float32).astype(kernel.dtype)
+    return dx, dk
+
+
+linear_with_grad_accumulation.defvjp(_lga_fwd, _lga_bwd)
+
+
 class ColumnParallelLinear:
     """Y = X @ A + b with A sharded column-wise: A = [A_1 .. A_p].
 
@@ -52,11 +94,13 @@ class ColumnParallelLinear:
                  bias: bool = True, gather_output: bool = True,
                  sequence_parallel_enabled: bool = False,
                  sequence_parallel_seq_dim: int = 0,
+                 gradient_accumulation_fusion: bool = False,
                  params_dtype=jnp.float32, tp_size: Optional[int] = None):
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
         self.gather_output = gather_output
+        self.gradient_accumulation_fusion = gradient_accumulation_fusion
         self.sequence_parallel_enabled = sequence_parallel_enabled
         self.sequence_parallel_seq_dim = sequence_parallel_seq_dim
         self.params_dtype = params_dtype
@@ -91,7 +135,10 @@ class ColumnParallelLinear:
         else:
             # fwd identity / bwd allreduce of dX across TP ranks
             x = mappings.copy_to_tensor_model_parallel_region(x)
-        y = jnp.dot(x, params["kernel"].astype(x.dtype))
+        if self.gradient_accumulation_fusion:
+            y = linear_with_grad_accumulation(x, params["kernel"])
+        else:
+            y = jnp.dot(x, params["kernel"].astype(x.dtype))
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         if self.gather_output:
@@ -109,11 +156,13 @@ class RowParallelLinear:
                  bias: bool = True, input_is_parallel: bool = True,
                  sequence_parallel_enabled: bool = False,
                  sequence_parallel_seq_dim: int = 0,
+                 gradient_accumulation_fusion: bool = False,
                  params_dtype=jnp.float32, tp_size: Optional[int] = None):
         self.in_features = in_features
         self.out_features = out_features
         self.use_bias = bias
         self.input_is_parallel = input_is_parallel
+        self.gradient_accumulation_fusion = gradient_accumulation_fusion
         self.sequence_parallel_enabled = sequence_parallel_enabled
         self.sequence_parallel_seq_dim = sequence_parallel_seq_dim
         self.params_dtype = params_dtype
@@ -142,7 +191,10 @@ class RowParallelLinear:
     def apply(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
         if not self.input_is_parallel:
             x = mappings.scatter_to_tensor_model_parallel_region(x)
-        y = jnp.dot(x, params["kernel"].astype(x.dtype))
+        if self.gradient_accumulation_fusion:
+            y = linear_with_grad_accumulation(x, params["kernel"])
+        else:
+            y = jnp.dot(x, params["kernel"].astype(x.dtype))
         if self.sequence_parallel_enabled:
             y = mappings.reduce_scatter_to_sequence_parallel_region(
                 y, self.sequence_parallel_seq_dim)

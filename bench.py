@@ -37,12 +37,13 @@ import jax.numpy as jnp
 BERT_LARGE_PARAMS = 336e6  # ≈ param count incl. embeddings
 
 
-def _prev_value(metric):
-    """Latest recorded value for `metric` from driver BENCH_r*.json files
-    (the driver nests the printed line under "parsed")."""
+def _recorded_values(metric):
+    """All recorded values for `metric` from driver BENCH_r*.json files
+    (the driver nests the printed line under "parsed"), oldest first."""
+    vals = []
     runs = sorted(glob.glob(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
-    for path in reversed(runs):
+    for path in runs:
         try:
             rec = json.load(open(path))
         except Exception:
@@ -50,18 +51,26 @@ def _prev_value(metric):
         parsed = rec.get("parsed") or {}
         candidates = [parsed] if isinstance(parsed, dict) else list(parsed)
         for c in candidates:
-            if isinstance(c, dict) and c.get("metric") == metric:
-                return c.get("value")
-    return None
+            if isinstance(c, dict) and c.get("metric") == metric \
+                    and c.get("value"):
+                vals.append(c["value"])
+    return vals
 
 
 def emit(metric, value, unit, extra=None, higher_is_better=True):
-    prev = _prev_value(metric)
-    vs = None
-    if prev:
-        vs = (value / prev) if higher_is_better else (prev / value)
+    """vs_baseline compares to the LATEST recorded round; vs_best to the
+    best round EVER, so a regression-after-a-regression can't report >1
+    (round-3 verdict weak #8). Both >1 = this run is better."""
+    prior = _recorded_values(metric)
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
-           "vs_baseline": round(vs, 3) if vs else None}
+           "vs_baseline": None}
+    if prior:
+        prev = prior[-1]
+        best = max(prior) if higher_is_better else min(prior)
+        ratio = (lambda new, old: new / old) if higher_is_better \
+            else (lambda new, old: old / new)
+        rec["vs_baseline"] = round(ratio(value, prev), 3)
+        rec["vs_best"] = round(ratio(value, best), 3)
     if extra:
         rec.update(extra)
     print(json.dumps(rec), flush=True)
@@ -305,6 +314,25 @@ def bench_flash_attention(on_tpu):
         emit(f"flash_attention_{name}_seq{s}_fwdbwd", dt * 1e3, "ms/iter",
              extra={"tflops": round(flops / dt / 1e12, 1)},
              higher_is_better=False)
+
+    # long-seq causal line (kernel only: materialized scores at 4096 would
+    # need a 4.3 GB fp32 tensor; b halved to keep the working set fair)
+    b2, s2 = (2, 4096) if on_tpu else (1, 512)
+    q2, k2, v2 = (jax.random.normal(kk, (b2, h, s2, d), jnp.bfloat16)
+                  for kk in ks)
+
+    def body2(q2):
+        g = jax.grad(lambda q2: jnp.sum(flash_attention(
+            q2, k2, v2, causal=True, use_kernel=True).astype(jnp.float32)
+            ** 2))(q2)
+        return (g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-6)).astype(q2.dtype)
+
+    dt = timed(body2, q2, lambda x: jnp.sum(x.astype(jnp.float32)),
+               M=10 if on_tpu else 2)
+    flops = 2 * 3.5 * b2 * h * s2 * s2 * d
+    emit(f"flash_attention_kernel_seq{s2}_fwdbwd", dt * 1e3, "ms/iter",
+         extra={"tflops": round(flops / dt / 1e12, 1)},
+         higher_is_better=False)
 
 
 # -- config 1/headline: BERT-Large pretrain step ----------------------------

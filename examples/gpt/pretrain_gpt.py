@@ -43,6 +43,9 @@ def extra_flags(p):
     g = p.add_argument_group("pretrain")
     g.add_argument("--steps", type=int, default=10)
     g.add_argument("--use-distributed-optimizer", action="store_true")
+    g.add_argument("--gradient-accumulation-fusion", action="store_true",
+                   help="per-layer fp32 wgrad emission in the TP linears "
+                        "(Megatron --gradient-accumulation-fusion)")
     g.add_argument("--seed", type=int, default=0)
     return p
 
@@ -64,7 +67,8 @@ def main():
         num_layers=ns.num_layers, num_heads=ns.num_attention_heads,
         ffn_hidden_size=4 * ns.hidden_size,
         max_position_embeddings=ns.max_position_embeddings,
-        sequence_parallel=ns.sequence_parallel)
+        sequence_parallel=ns.sequence_parallel,
+        gradient_accumulation_fusion=ns.gradient_accumulation_fusion)
     model = GPTModel(cfg, tp_size=tp_sz)
     params = init_gpt(jax.random.PRNGKey(ns.seed), cfg)
     pipe_params = gpt_to_pipeline_params(params, cfg, pp)

@@ -109,6 +109,32 @@ def test_fused_lamb_flat_kernel_matches_tree_path(kw):
     assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("kw", [
+    dict(weight_decay=0.01),
+    dict(weight_decay=0.01, reg_inside_moment=True),
+    dict(weight_decay=0.0, grad_averaging=False),
+    dict(weight_decay=0.01, init_zero=True),
+])
+def test_fused_novograd_flat_kernel_matches_tree_path(kw):
+    params = make_params(jax.random.PRNGKey(5))
+    got, _ = run_steps(FusedNovoGrad(lr=1e-2, use_flat_kernel=True, **kw),
+                       params)
+    want, _ = run_steps(FusedNovoGrad(lr=1e-2, **kw), params)
+    assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(weight_decay=0.01),
+    dict(weight_decay=0.01, adagrad_w_mode=True),
+])
+def test_fused_adagrad_flat_kernel_matches_tree_path(kw):
+    params = make_params(jax.random.PRNGKey(6))
+    got, _ = run_steps(FusedAdagrad(lr=1e-2, use_flat_kernel=True, **kw),
+                       params)
+    want, _ = run_steps(FusedAdagrad(lr=1e-2, **kw), params)
+    assert_trees_close(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_fused_adam_skips_on_overflow():
     params = make_params(jax.random.PRNGKey(3))
     opt = FusedAdam(lr=1e-2)

@@ -1,0 +1,200 @@
+"""Collective-count contracts, asserted on compiled HLO.
+
+Numerics tests cannot catch an accidentally-inserted extra allreduce —
+an extra collective is numerically invisible and only shows up as lost
+step time on hardware. These tests compile representative TP / SP / CE /
+PP programs on the CPU mesh and count the collective ops in the
+optimized HLO against the Megatron comm contract (SURVEY §2a mappings —
+"the hottest comm in the stack"):
+
+- TP MLP block (Column gather_output=False -> gelu -> Row
+  input_is_parallel): ONE activation allreduce forward (end of Row), ONE
+  more in backward (transpose of copy_to at the Column input), plus a
+  bias-sized replicated-cotangent psum. Ref: ``mappings.py ::
+  _CopyToModelParallelRegion/_ReduceFrom...``.
+- SP MLP block: all-gather on seq entering Column, reduce-scatter
+  leaving Row — mirrored in backward; the Column wgrad reuses the saved
+  gathered input (no third AG). No activation allreduce. Ref:
+  ``mappings.py`` sequence-parallel regions.
+- vocab-parallel CE: three semantic psums forward (max, sum-exp, target
+  logit; XLA combines the two sums -> 2 ops), ZERO new in backward
+  (shard-local softmax-minus-onehot). Ref: ``cross_entropy.py ::
+  _VocabParallelCrossEntropy``.
+- collective 1F1B: exactly TWO collective-permutes per tick (activations
+  +1, cotangents -1) — the scan body appears once in HLO. Ref:
+  ``p2p_communication.py :: _communicate``.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer import tensor_parallel as tp
+
+TP = 8
+M = P(ps.TENSOR_AXIS)
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute")
+
+
+_SINGLETON = re.compile(r"replica_groups=\{\{\d+\},")
+
+
+def _counts(fn, *args):
+    """Count communicating collective ops in optimized HLO. Excludes
+    degenerate singleton-replica-group ops (XLA artifacts that move no
+    bytes). NOTE: XLA's combiner may merge same-kind reductions into one
+    op with multiple operands — counts are ops, i.e. launches, which is
+    the structure that costs latency."""
+    text = jax.jit(fn).lower(*args).compile().as_text()
+    out = dict.fromkeys(_COLLECTIVES, 0)
+    for line in text.splitlines():
+        for c in _COLLECTIVES:
+            if f" {c}(" in line or f" {c}-start(" in line:
+                if not _SINGLETON.search(line):
+                    out[c] += 1
+    return out
+
+
+def _mlp_block(sequence_parallel):
+    col = tp.ColumnParallelLinear(
+        16, 32, gather_output=False,
+        sequence_parallel_enabled=sequence_parallel)
+    row = tp.RowParallelLinear(
+        32, 16, input_is_parallel=True,
+        sequence_parallel_enabled=sequence_parallel)
+    cp = col.init(jax.random.PRNGKey(0))
+    rp = row.init(jax.random.PRNGKey(1))
+
+    def block(cp, rp, x):
+        return row.apply(rp, jax.nn.gelu(col.apply(cp, x)))
+
+    return block, col, row, cp, rp
+
+
+def test_tp_mlp_forward_one_allreduce():
+    ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    block, col, row, cp, rp = _mlp_block(False)
+    x = jnp.ones((4, 16))
+    fwd = ps.shard_map(block,
+                       in_specs=(col.partition_specs(),
+                                 row.partition_specs(), P()),
+                       out_specs=P())
+    c = _counts(fwd, cp, rp, x)
+    assert c["all-reduce"] == 1, c
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0, c
+
+
+def test_tp_mlp_backward_adds_exactly_one_allreduce():
+    ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    block, col, row, cp, rp = _mlp_block(False)
+    x = jnp.ones((4, 16))
+
+    def loss(cp, rp, x):
+        y = ps.shard_map(block,
+                         in_specs=(col.partition_specs(),
+                                   row.partition_specs(), P()),
+                         out_specs=P())(cp, rp, x)
+        return jnp.sum(y ** 2)
+
+    # grad program = fwd (1 AR) + bwd dx psum (1 AR, the copy_to
+    # transpose) + the Row bias cotangent psum (bias-sized — shard_map's
+    # transpose rule for a replicated input; Megatron computes that grad
+    # rank-locally, but 16 floats of AR is noise next to the activation
+    # AR, so the structure is pinned rather than fought)
+    c = _counts(jax.grad(loss, argnums=(0, 1, 2)), cp, rp, x)
+    assert c["all-reduce"] == 3, c
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0, c
+
+
+def test_sp_mlp_forward_ag_rs_no_allreduce():
+    ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    block, col, row, cp, rp = _mlp_block(True)
+    x = jnp.ones((16, 2, 16))
+    fwd = ps.shard_map(block,
+                       in_specs=(col.partition_specs(),
+                                 row.partition_specs(), M),
+                       out_specs=M)
+    c = _counts(fwd, cp, rp, x)
+    assert c["all-gather"] == 1 and c["reduce-scatter"] == 1, c
+    assert c["all-reduce"] == 0, c
+
+
+def test_sp_mlp_backward_mirrors_ag_rs():
+    ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    block, col, row, cp, rp = _mlp_block(True)
+    x = jnp.ones((16, 2, 16))
+
+    def loss(cp, rp, x):
+        y = ps.shard_map(block,
+                         in_specs=(col.partition_specs(),
+                                   row.partition_specs(), M),
+                         out_specs=M)(cp, rp, x)
+        return jnp.sum(y ** 2)
+
+    c = _counts(jax.grad(loss, argnums=(0, 1, 2)), cp, rp, x)
+    # fwd AG + RS, bwd RS-transpose=AG(cotangent) + AG-transpose=RS;
+    # the Column wgrad reuses the SAVED gathered input (no third AG —
+    # the memory-for-comm trade Megatron's sequence_parallel also
+    # defaults to). The one AR is the bias-sized replicated-cotangent
+    # psum (see the TP backward test).
+    assert c["all-reduce"] == 1, c
+    assert c["all-gather"] == 2 and c["reduce-scatter"] == 2, c
+
+
+def test_vocab_parallel_ce_fwd_allreduces_zero_bwd():
+    ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    V, B = 64, 4
+    logits = jnp.ones((B, V), jnp.float32)
+    target = jnp.zeros((B,), jnp.int32)
+
+    def fwd(lg, tg):
+        return ps.shard_map(
+            tp.vocab_parallel_cross_entropy,
+            in_specs=(P(None, ps.TENSOR_AXIS), P()),
+            out_specs=P())(lg, tg)
+
+    # three semantic psums (max, sum-exp, target logit); XLA's combiner
+    # merges the two same-kind sums into one op -> 2 launches
+    c = _counts(fwd, logits, target)
+    assert c["all-reduce"] == 2, c
+
+    def loss(lg):
+        return jnp.sum(fwd(lg, target))
+
+    cg = _counts(jax.grad(loss), logits)
+    # backward is shard-local: no NEW collectives beyond the forward's
+    assert cg["all-reduce"] == 2, cg
+
+
+def test_1f1b_two_collective_permutes_per_tick():
+    import importlib.util as ilu
+    import os
+
+    spec = ilu.spec_from_file_location(
+        "_pp_rig", os.path.join(os.path.dirname(__file__),
+                                "test_pipeline_parallel.py"))
+    rig = ilu.module_from_spec(spec)
+    spec.loader.exec_module(rig)
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fb,
+    )
+
+    pp, n_mb = 4, 8
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=pp,
+                                 devices=jax.devices()[:pp])
+    params = rig._init(jax.random.PRNGKey(0), pp)
+    batch = rig._batch(jax.random.PRNGKey(1), 2 * n_mb)
+    fn = ps.shard_map(
+        lambda p, b: fb(rig.MODEL, p, b, num_microbatches=n_mb),
+        in_specs=({"embed": P(), "stages": P(ps.PIPE_AXIS), "head": P()},
+                  P()),
+        out_specs=(P(), {"embed": P(), "stages": P(ps.PIPE_AXIS),
+                         "head": P()}),
+    )
+    c = _counts(fn, params, batch)
+    assert c["collective-permute"] == 2, c
