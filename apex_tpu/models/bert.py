@@ -41,6 +41,11 @@ class BertConfig:
     # fused flash-attention path (ref: apex/contrib multihead_attn/fmha);
     # False falls back to materialized scores + fused softmax kernel
     fused_attention: bool = True
+    # jax.checkpoint each encoder layer: one hidden state per layer of
+    # live memory plus recompute — unlocks per-chip batch 32 for
+    # BERT-Large amp O2 on v5e (b=32 OOMs without it). Ref analogue:
+    # tensor_parallel/random.py::CheckpointFunction discipline.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -168,17 +173,26 @@ def apply_bert(params: Dict[str, Any], cfg: BertConfig,
             if dropout_rng is not None else [None] * (2 * cfg.num_layers + 1))
     x = _maybe_dropout(x, cfg.hidden_dropout, rngs[0])
 
-    for li, layer in enumerate(params["encoder"]):
-        with jax.named_scope(f"layer{li}/attention"):
+    def encoder_layer(layer, x, rng_a, rng_h):
+        with jax.named_scope("attention"):
             att = _attention(layer["attention"], cfg, x, attention_mask,
-                             rngs[2 * li + 1])
-            att = _maybe_dropout(att, cfg.hidden_dropout, rngs[2 * li + 2])
+                             rng_a)
+            att = _maybe_dropout(att, cfg.hidden_dropout, rng_h)
             x = _ln(layer["attention"]["layernorm"], x + att,
                     cfg.layer_norm_eps)
-        with jax.named_scope(f"layer{li}/mlp"):
+        with jax.named_scope("mlp"):
             mlp = L.dense(layer["mlp"]["fc2"],
                           jax.nn.gelu(L.dense(layer["mlp"]["fc1"], x)))
             x = _ln(layer["mlp"]["layernorm"], x + mlp, cfg.layer_norm_eps)
+        return x
+
+    if cfg.remat:
+        encoder_layer = jax.checkpoint(encoder_layer,
+                                       static_argnums=())
+    for li, layer in enumerate(params["encoder"]):
+        with jax.named_scope(f"layer{li}"):
+            x = encoder_layer(layer, x, rngs[2 * li + 1],
+                              rngs[2 * li + 2])
 
     head = params["mlm_head"]
     t = jax.nn.gelu(L.dense(head["transform"], x))

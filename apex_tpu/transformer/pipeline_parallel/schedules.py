@@ -354,55 +354,138 @@ def forward_backward_pipelining_with_interleaving(
         raise ValueError("interleaved schedule requires a virtual "
                          "pipeline size (initialize_model_parallel("
                          "virtual_pipeline_model_parallel_size_=...))")
+    del checkpoint_stages  # recompute-from-saved-input is inherent
     M = _num_microbatches(num_microbatches)
     mbs = split_batch_into_microbatches(batch, M)
     pp = lax.axis_size(ps.PIPE_AXIS)
     d = lax.axis_index(ps.PIPE_AXIS)
-    stage = _stage_apply(model, checkpoint_stages)
+    stage = model.stage_fn
+    stage_p = jax.tree.map(lambda a: a[:, 0], params["stages"])  # (vpp,...)
+    embed_p, head_p = params["embed"], params["head"]
     n_chunks = pp * vpp
-    T = M + n_chunks - 1
+    # chunk ids this device hosts, one per lane: c(l) = l*pp + d
+    chunk = jnp.arange(vpp) * pp + d
+    state0 = _hidden_proto(model, embed_p, _mb_at(mbs, 0, M))
+    lanes0 = jnp.zeros((vpp,) + state0.shape, state0.dtype)
 
-    def compute_loss(p):
-        stage_p = jax.tree.map(lambda a: a[:, 0], p["stages"])  # (vpp, ...)
-        xs = jax.vmap(model.embed_fn, in_axes=(None, 0))(p["embed"], mbs)
+    def fwd_lanes(t, lanes):
+        """One tick of the forward wave: inject at chunk 0, apply every
+        resident chunk, rotate +1 with the stage-0 lane roll (a chunk
+        boundary wraps from the last stage back to the first)."""
+        inject = model.embed_fn(embed_p, _mb_at(mbs, t, M))
+        lane0 = jnp.where(d == 0, inject, lanes[0])
+        x_in = jnp.concatenate([lane0[None], lanes[1:]], axis=0)
+        ys = jax.vmap(stage)(stage_p, x_in)
+        return x_in, ys
 
-        def tick(carry, t):
-            lanes, outs = carry  # lanes: (vpp,) + hidden shape
-            inject = lax.dynamic_index_in_dim(
-                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            lane0 = jnp.where(d == 0, inject, lanes[0])
-            x_in = jnp.concatenate([lane0[None], lanes[1:]], axis=0)
-            ys = jax.vmap(stage)(stage_p, x_in)  # one chunk per lane
-            # chunk n_chunks-1 output = lane vpp-1 on the last stage
-            slot = jnp.clip(t - (n_chunks - 1), 0, M - 1)
-            valid = t >= n_chunks - 1
-            old = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
-            outs = lax.dynamic_update_index_in_dim(
-                outs, jnp.where(valid, ys[vpp - 1], old), slot, 0)
-            recv = send_forward_recv_forward(ys)
-            # wraparound chunk boundary: stage 0's lane l continues the
-            # work the last stage finished on lane l-1
-            lanes = jnp.where(d == 0, jnp.roll(recv, 1, axis=0), recv)
-            return (lanes, outs), None
-
-        hidden0 = jnp.zeros_like(xs[0])
-        lanes0 = jnp.zeros((vpp,) + hidden0.shape, hidden0.dtype)
-        outs0 = jnp.zeros((M,) + hidden0.shape, hidden0.dtype)
-        (_, outs), _ = lax.scan(tick, (lanes0, outs0), jnp.arange(T))
-
-        losses = jax.vmap(model.loss_fn, in_axes=(None, 0, 0))(
-            p["head"], outs, mbs)
-        local = losses.mean().astype(jnp.float32)
-        # masked local, NOT psum — see the non-interleaved schedule's note
-        return jnp.where(d == pp - 1, local, 0.0)
+    def rotate_fwd(ys):
+        recv = send_forward_recv_forward(ys)
+        return jnp.where(d == 0, jnp.roll(recv, 1, axis=0), recv)
 
     if forward_only:
-        return lax.psum(compute_loss(params), ps.PIPE_AXIS), None
-    loss, grads = jax.value_and_grad(compute_loss)(params)
-    loss = lax.psum(loss, ps.PIPE_AXIS)
-    grads = dict(grads)
-    grads["embed"] = lax.psum(grads["embed"], ps.PIPE_AXIS)
-    grads["head"] = lax.psum(grads["head"], ps.PIPE_AXIS)
+        T = M + n_chunks - 1
+
+        def tick_f(carry, t):
+            lanes, acc = carry
+            _, ys = fwd_lanes(t, lanes)
+            m_l = t - (n_chunks - 1)
+            l = model.loss_fn(head_p, ys[vpp - 1], _mb_at(mbs, m_l, M))
+            acc = acc + jnp.where((m_l >= 0) & (d == pp - 1),
+                                  l.astype(jnp.float32), 0.0)
+            return (rotate_fwd(ys), acc), None
+
+        (_, total), _ = lax.scan(tick_f, (lanes0, jnp.float32(0)),
+                                 jnp.arange(T))
+        return lax.psum(total / M, ps.PIPE_AXIS), None
+
+    # Backward written into the tick, as in the plain schedule: chunk c
+    # forwards microbatch t-c and backwards microbatch t-2(N-1)+c, with
+    # per-lane input rings of depth 2N-1 bounding live activations at
+    # O(vpp * N * microbatch) — the interleaved schedule's higher
+    # in-flight count, independent of M.
+    R = 2 * n_chunks - 1
+    T = M + 2 * (n_chunks - 1)
+
+    def tick(carry, t):
+        lanes, cot, ring, g_stage, g_embed, g_head, loss_acc = carry
+
+        # -- forward half ----------------------------------------------
+        m_f = t - chunk                       # (vpp,) microbatch per lane
+        x_in, ys = fwd_lanes(t, lanes)
+        slot_f = jnp.mod(m_f, R)
+        fwd_valid = (m_f >= 0) & (m_f < M)
+
+        def save(ring_l, x_l, slot_l, ok_l):
+            old = lax.dynamic_index_in_dim(ring_l, slot_l, 0,
+                                           keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                ring_l, jnp.where(ok_l, x_l, old), slot_l, 0)
+
+        ring = jax.vmap(save)(ring, x_in, slot_f, fwd_valid)
+
+        # -- loss half: chunk N-1 = lane vpp-1 on the last stage -------
+        m_l = t - (n_chunks - 1)
+        loss_valid = (m_l >= 0) & (m_l < M)
+        mb_l = _mb_at(mbs, m_l, M)
+        l, loss_vjp = jax.vjp(
+            lambda hp, yy: model.loss_fn(hp, yy, mb_l), head_p,
+            ys[vpp - 1])
+        seed = jnp.where(loss_valid & (d == pp - 1), 1.0 / M, 0.0)
+        dhead, dy_loss = loss_vjp(seed.astype(l.dtype))
+        loss_acc = loss_acc + jnp.where(loss_valid & (d == pp - 1),
+                                        l.astype(jnp.float32), 0.0)
+        g_head = _masked_axpy(g_head, dhead, True)  # seed already masks
+
+        # -- backward half ---------------------------------------------
+        m_b = t - 2 * (n_chunks - 1) + chunk  # (vpp,)
+        bwd_valid = (m_b >= 0) & (m_b < M)
+        # chunk N-1 seeds from this tick's loss; every other chunk uses
+        # the cotangent received from chunk c+1 (rotated in last tick)
+        last = (jnp.arange(vpp) == vpp - 1) & (d == pp - 1)
+        g_in = jnp.where(last.reshape((vpp,) + (1,) * dy_loss.ndim),
+                         dy_loss[None], cot)
+        x_saved = jax.vmap(
+            lambda ring_l, slot_l: lax.dynamic_index_in_dim(
+                ring_l, slot_l, 0, keepdims=False))(ring, jnp.mod(m_b, R))
+
+        def lane_bwd(sp_l, x_l, g_l):
+            _, vjp_l = jax.vjp(stage, sp_l, x_l)
+            return vjp_l(g_l)
+
+        dstage, dx = jax.vmap(lane_bwd)(stage_p, x_saved, g_in)
+        g_stage = jax.tree.map(
+            lambda a, b: a + jnp.where(
+                bwd_valid.reshape((vpp,) + (1,) * (b.ndim - 1)), b, 0
+            ).astype(a.dtype), g_stage, dstage)
+        # chunk 0 (lane 0, stage 0) feeds the embed backward
+        mb_b0 = _mb_at(mbs, m_b[0], M)
+        _, embed_vjp = jax.vjp(lambda ep: model.embed_fn(ep, mb_b0),
+                               embed_p)
+        (dembed,) = embed_vjp(dx[0])
+        g_embed = _masked_axpy(g_embed, dembed, bwd_valid[0] & (d == 0))
+
+        # rotate: activations +1 with stage-0 roll; cotangents -1 with
+        # the mirrored roll at the LAST stage (chunk (l+1)*pp flows back
+        # to chunk l*pp + pp-1)
+        cot_recv = send_backward_recv_backward(dx)
+        cot_next = jnp.where(d == pp - 1, jnp.roll(cot_recv, -1, axis=0),
+                             cot_recv)
+        return (rotate_fwd(ys), cot_next, ring, g_stage, g_embed, g_head,
+                loss_acc), None
+
+    carry0 = (lanes0, jnp.zeros_like(lanes0),
+              jnp.zeros((vpp, R) + state0.shape, state0.dtype),
+              _zeros_f32_like(stage_p), _zeros_f32_like(embed_p),
+              _zeros_f32_like(head_p), jnp.float32(0))
+    (_, _, _, g_stage, g_embed, g_head, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(loss_acc, ps.PIPE_AXIS) / M
+    grads = {
+        "stages": jax.tree.map(lambda a: a[:, None], g_stage),
+        "embed": lax.psum(g_embed, ps.PIPE_AXIS),
+        "head": lax.psum(g_head, ps.PIPE_AXIS),
+    }
     return loss, grads
 
 

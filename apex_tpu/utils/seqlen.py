@@ -1,0 +1,83 @@
+"""Variable-sequence-length support via bucketing.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py ::
+_communicate`` ships a shape/dtype handshake (``variable_seq_lengths``)
+so adjacent pipeline ranks can exchange ragged activations. XLA requires
+static shapes, so the TPU-native equivalent is the standard bucketing
+discipline: pad every batch up to one of a SMALL set of compiled
+lengths. Each bucket compiles once; steady-state training touches one
+or two buckets, and the padding fraction is bounded by the bucket
+ratio (2x for the default power-of-two ladder, typically far less).
+
+The helpers are deliberately tiny and explicit — they are the missing
+piece that lets a ragged data loader feed the static-shape kernels and
+schedules; masks produced here flow into the attention/loss masks the
+models already consume.
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_DEFAULT_MIN = 128
+
+
+def default_buckets(max_len: int, min_len: int = _DEFAULT_MIN
+                    ) -> Tuple[int, ...]:
+    """Power-of-two ladder ``min_len, 2*min_len, ... >= max_len``."""
+    if max_len < 1:
+        raise ValueError("max_len must be positive")
+    out = []
+    b = min_len
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(b)
+    return tuple(out)
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length (raises if none fits — the loader's
+    truncation policy, not padding, handles over-long examples)."""
+    for b in sorted(buckets):
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        f"sequence length {length} exceeds the largest bucket "
+        f"{max(buckets)}; truncate upstream or extend the buckets")
+
+
+def pad_to_bucket(batch: Any, length: int, *, seq_axis: int = 1,
+                  buckets: Optional[Sequence[int]] = None,
+                  pad_value=0) -> Tuple[Any, jax.Array]:
+    """Pad every leaf of ``batch`` along ``seq_axis`` from ``length`` to
+    its bucket; returns ``(padded_batch, mask)`` where ``mask`` is
+    ``(bucket,)`` int32 with 1 = real position (broadcast it into the
+    models' ``(b, s)`` attention-mask convention as needed).
+
+    ``length`` is the CURRENT ragged length (leaves must agree on it);
+    bucketing is a host-side, trace-free decision — call this in the
+    data loader, outside jit, so each bucket length hits one compiled
+    executable.
+    """
+    if buckets is None:
+        buckets = default_buckets(length)
+    target = bucket_for(length, buckets)
+
+    def pad(a):
+        a = np.asarray(a) if not isinstance(a, (jax.Array, np.ndarray)) \
+            else a
+        if a.shape[seq_axis] != length:
+            raise ValueError(
+                f"leaf has seq length {a.shape[seq_axis]}, expected "
+                f"{length}")
+        if target == length:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[seq_axis] = (0, target - length)
+        return jnp.pad(a, widths, constant_values=pad_value)
+
+    mask = (jnp.arange(target) < length).astype(jnp.int32)
+    return jax.tree.map(pad, batch), mask

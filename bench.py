@@ -338,25 +338,49 @@ def bench_flash_attention(on_tpu):
 # -- config 1/headline: BERT-Large pretrain step ----------------------------
 
 def bench_headline(on_tpu):
+    import dataclasses
+
     from apex_tpu.models import bert_large, bert_tiny
 
-    cfg = bert_large() if on_tpu else bert_tiny()
-    batch, seq = (16, 128) if on_tpu else (2, 64)  # see bench_ddp_bert
-    train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
+    base = bert_large() if on_tpu else bert_tiny()
+    seq = 128 if on_tpu else 64
+    # b=16 was the assumed no-remat HBM ceiling (b=32 OOMs); b=24 fits
+    # without remat and amortizes the ~17 ms/step of memory-bound fixed
+    # work (optimizer + master-weight traffic — see BASELINE.md roofline)
+    # over 1.5x the samples; remat unlocks b=32 at ~33% fwd recompute.
+    # Measure all three, report the winner.
+    configs = [(16, False), (24, False), (32, True)] if on_tpu \
+        else [(2, False)]
+    best = None
+    for batch, remat in configs:
+        cfg = dataclasses.replace(base, remat=remat)
+        train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
 
-    def body(st):
-        m, o, sc, loss = train_step(st[0], st[1], st[2], ids, mask)
-        return (m, o, sc, loss)
+        def body(st, train_step=train_step, ids=ids, mask=mask):
+            m, o, sc, loss = train_step(st[0], st[1], st[2], ids, mask)
+            return (m, o, sc, loss)
 
-    init = (*state, jnp.float32(0))
-    dt = timed(body, init, lambda s: s[3], M=10 if on_tpu else 2, K=5)
-    sps = batch / dt
+        init = (*state, jnp.float32(0))
+        try:
+            dt = timed(body, init, lambda s: s[3], M=10 if on_tpu else 2,
+                       K=5)
+        except Exception as e:  # OOM at a candidate config: skip it
+            print(json.dumps({"metric": f"headline_b{batch}_remat{remat}",
+                              "error": repr(e)[:160]}), flush=True)
+            continue
+        sps = batch / dt
+        if best is None or sps > best[0]:
+            best = (sps, batch, remat, dt)
+    if best is None:
+        raise RuntimeError(
+            "every headline config failed (see the error lines above)")
+    sps, batch, remat, dt = best
     tflops = 6 * BERT_LARGE_PARAMS * batch * seq / dt / 1e12 if on_tpu \
         else 0.0
     metric = ("bert_large_pretrain_step_amp_O2_fused_adam"
               if on_tpu else "bert_tiny_cpu_smoke")
     emit(metric, sps, "samples/sec/chip",
-         extra={"batch": batch, "seq": seq,
+         extra={"batch": batch, "seq": seq, "remat": remat,
                 "step_ms": round(dt * 1e3, 2),
                 "tflops": round(tflops, 1)})
 

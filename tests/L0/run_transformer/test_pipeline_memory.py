@@ -93,3 +93,33 @@ def test_forward_only_memory_flat_in_num_microbatches():
         return fn.lower(params, batch).compile().as_text()
 
     assert _max_fp_buffer_bytes(hlo(16)) <= _max_fp_buffer_bytes(hlo(4))
+
+
+def test_interleaved_memory_flat_in_num_microbatches():
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+    )
+
+    pp, vpp = 2, 2
+    ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=pp,
+        virtual_pipeline_model_parallel_size_=vpp,
+        devices=jax.devices()[:pp])
+
+    def hlo(n_mb):
+        params = _init(jax.random.PRNGKey(0), pp * vpp)
+        params = dict(params)
+        params["stages"] = jax.tree.map(
+            lambda a: a.reshape((vpp, pp) + a.shape[1:]), params["stages"])
+        batch = _batch(jax.random.PRNGKey(1), 2 * n_mb)
+        fn = jax.jit(ps.shard_map(
+            lambda p, b: forward_backward_pipelining_with_interleaving(
+                MODEL, p, b, num_microbatches=n_mb),
+            in_specs=({"embed": P(), "stages": P(None, ps.PIPE_AXIS),
+                       "head": P()}, P()),
+            out_specs=(P(), {"embed": P(), "stages": P(None, ps.PIPE_AXIS),
+                             "head": P()}),
+        ))
+        return fn.lower(params, batch).compile().as_text()
+
+    assert _max_fp_buffer_bytes(hlo(16)) <= _max_fp_buffer_bytes(hlo(4))

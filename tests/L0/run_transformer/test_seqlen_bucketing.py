@@ -1,0 +1,50 @@
+"""Variable-seqlen bucketing (the static-shape answer to the
+reference's ``variable_seq_lengths`` p2p handshake — SURVEY §2a
+``p2p_communication.py :: _communicate``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
+
+
+def test_default_buckets_ladder():
+    assert default_buckets(1000) == (128, 256, 512, 1024)
+    assert default_buckets(128) == (128,)
+    assert default_buckets(129) == (128, 256)
+
+
+def test_bucket_for_and_overflow():
+    bs = (128, 256, 512)
+    assert bucket_for(1, bs) == 128
+    assert bucket_for(256, bs) == 256
+    assert bucket_for(257, bs) == 512
+    with pytest.raises(ValueError, match="largest bucket"):
+        bucket_for(513, bs)
+
+
+def test_pad_to_bucket_pads_and_masks():
+    batch = {"ids": jnp.ones((2, 200), jnp.int32),
+             "labels": jnp.ones((2, 200), jnp.int32)}
+    padded, mask = pad_to_bucket(batch, 200, buckets=(128, 256))
+    assert padded["ids"].shape == (2, 256)
+    assert int(mask.sum()) == 200 and mask.shape == (256,)
+    np.testing.assert_array_equal(np.asarray(padded["ids"][:, 200:]), 0)
+
+
+def test_one_compile_per_bucket():
+    """Two ragged lengths in one bucket -> ONE compiled executable."""
+    traces = []
+
+    @jax.jit
+    def step(ids, mask):
+        traces.append(1)
+        return (ids * mask[None]).sum()
+
+    for ln in (130, 200, 256):
+        padded, mask = pad_to_bucket({"ids": jnp.ones((2, ln), jnp.int32)},
+                                     ln, buckets=(128, 256))
+        step(padded["ids"], mask)
+    assert len(traces) == 1  # all three land in the 256 bucket
