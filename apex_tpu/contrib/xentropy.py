@@ -28,7 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils.math import round_up_to_multiple
-from apex_tpu.utils.pallas import NEG_INF as _NEG, pad2 as _pad2
+from apex_tpu.utils.pallas import dimsem as _dimsem, NEG_INF as _NEG, pad2 as _pad2
 from apex_tpu.utils.platform import pallas_interpret
 
 _BR = 256     # rows per block (sublane dim)
@@ -126,6 +126,11 @@ def _fwd_call(logits, labels, eps, interpret):
         out_shape=(jax.ShapeDtypeStruct((1, n_p), jnp.float32),
                    jax.ShapeDtypeStruct((1, n_p), jnp.float32)),
         scratch_shapes=[pltpu.VMEM((_BR, 128), jnp.float32)] * 4,
+        # BOTH dims arbitrary: the (1, n_p) loss/lse outputs are one
+        # revisited block each row-tile writes a slice of — a "parallel"
+        # rt could be split across megacore TensorCores, each holding a
+        # private copy and losing the other's slices
+        compiler_params=_dimsem("arbitrary", "arbitrary"),
         interpret=pallas_interpret(interpret),
     )(xp, lab)
     return loss[0, :n], lse  # lse stays padded (1, n_p)
@@ -149,6 +154,7 @@ def _bwd_call(logits, labels, lse_p, dloss, eps, interpret):
         in_specs=[x_spec, _row_spec(n_p), _row_spec(n_p), _row_spec(n_p)],
         out_specs=x_spec,
         out_shape=jax.ShapeDtypeStruct((n_p, v_p), logits.dtype),
+        compiler_params=_dimsem("parallel", "parallel"),
         interpret=pallas_interpret(interpret),
     )(xp, lab, lse_p, dl)
     return dx[:n, :v]

@@ -480,14 +480,19 @@ def gpt_loss_unsharded(params: Dict[str, Any], cfg: GPTConfig,
                        input_ids: jax.Array, labels: jax.Array,
                        *, dropout_rng: Optional[jax.Array] = None,
                        compute_dtype=None) -> jax.Array:
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
     hidden = apply_gpt_unsharded(params, cfg, input_ids,
                                  dropout_rng=dropout_rng,
                                  compute_dtype=compute_dtype)
     table = params["embedding"]["word"]["embedding"]
-    logits = jnp.dot(hidden, table.astype(hidden.dtype).T).astype(
-        jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    logits = jnp.dot(hidden, table.astype(hidden.dtype).T)
+    # fused xentropy (ref apex/contrib/xentropy): fp32 logsumexp inside
+    # the kernel, no (b, s, V) log-softmax ever materialized — at
+    # V=50304 that tensor dominated the unsharded step's HBM footprint
+    v = logits.shape[-1]
+    nll = softmax_cross_entropy_loss(logits.reshape(-1, v),
+                                     labels.reshape(-1))
     return nll.mean()
 
 
@@ -631,17 +636,24 @@ def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
 # bench hook (BASELINE config #5)
 # ---------------------------------------------------------------------------
 
-def gpt_tp_bench(on_tpu: bool, n_devices: int
+def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
+                 batch: Optional[int] = None, remat: bool = False
                  ) -> Tuple[Any, Any, Any, int]:
     """Returns (body, init_state, fetch, global_batch) for bench.py:
     a full TP train step (loss, grads inside shard_map; FusedAdam update)
-    on a tp=n mesh."""
+    on a tp=n mesh. ``batch``/``remat`` let bench.py sweep configs the
+    way the BERT headline does."""
+    import dataclasses
+
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from apex_tpu.optimizers import FusedAdam
 
     cfg = gpt_medium() if on_tpu else gpt_tiny()
-    batch, seq = (8, 1024) if on_tpu else (2, 32)
+    if remat:
+        cfg = dataclasses.replace(cfg, remat=True)
+    default_b, seq = (8, 1024) if on_tpu else (2, 32)
+    batch = default_b if batch is None else batch
     ids = jnp.zeros((batch, seq), jnp.int32)
     labels = jnp.zeros((batch, seq), jnp.int32)
     if n_devices == 1:

@@ -16,6 +16,7 @@ from apex_tpu.models import layers as L
 
 # (block counts, bottleneck?) per variant
 _SPECS = {
+    10: ((1, 1, 1, 1), False),  # test/CI tier: smallest compilable resnet
     18: ((2, 2, 2, 2), False),
     34: ((3, 4, 6, 3), False),
     50: ((3, 4, 6, 3), True),

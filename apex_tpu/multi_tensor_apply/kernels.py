@@ -22,11 +22,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.multi_tensor_apply.flatten import LANES
+from apex_tpu.multi_tensor_apply.flatten import ALIGN_ROWS, LANES
 from apex_tpu.utils.math import cdiv
+from apex_tpu.utils.pallas import dimsem as _dimsem
 from apex_tpu.utils.platform import pallas_interpret
-
-from apex_tpu.multi_tensor_apply.flatten import ALIGN_ROWS
 
 BLOCK_ROWS = ALIGN_ROWS  # (256, 128) fp32 tile = 128 KiB per buffer;
 # equals the FlatSpec whole-buffer alignment so flat buffers never need
@@ -83,6 +82,7 @@ def flat_scale(buf: jax.Array, scale, out_dtype=None,
             jax.ShapeDtypeStruct(x.shape, out_dtype or buf.dtype),
             jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
         ],
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, x)
     return out[:rows], jnp.logical_not(jnp.all(finite == 1))
@@ -117,6 +117,7 @@ def flat_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None,
             jax.ShapeDtypeStruct(xp.shape, out_dtype or x.dtype),
             jax.ShapeDtypeStruct((n_tiles, 1), jnp.int32),
         ],
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, xp, yp)
     return out[:rows], jnp.logical_not(jnp.all(finite == 1))
@@ -157,6 +158,7 @@ def flat_l2norm_partials(buf: jax.Array,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_tiles, _SUBS_PER_BLOCK),
                                        jnp.float32),
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(x)
     return parts.reshape(-1)
@@ -248,6 +250,7 @@ def flat_sgd(grads: jax.Array, params: jax.Array, momentum_buf: jax.Array,
         out_specs=[_tile_spec()] * 2,
         out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
         input_output_aliases={2: 0, 3: 1},
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, bp)
     return p_new[:rows], b_new[:rows]
@@ -337,6 +340,7 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
         out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3
         + [jax.ShapeDtypeStruct((n_tiles, _SUBS_PER_BLOCK), jnp.float32)] * 2,
         input_output_aliases={3: 0, 4: 1},
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, mp, vp)
 
@@ -404,6 +408,7 @@ def flat_adagrad(grads: jax.Array, params: jax.Array, gsum: jax.Array,
         out_specs=[_tile_spec()] * 2,
         out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
         input_output_aliases={2: 0, 3: 1},
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, sp)
     return p_new[:rows], s_new[:rows]
@@ -490,6 +495,7 @@ def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
         out_specs=[_tile_spec()] * 2,
         out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
         input_output_aliases={3: 0, 4: 1},
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, row_denom, gp, pp, mp)
     return p_new[:rows], m_new[:rows], v_new
@@ -529,6 +535,7 @@ def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
         out_specs=[_tile_spec()] * 3,
         out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3,
         input_output_aliases={2: 0, 3: 1, 4: 2},
+        compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, mp, vp)
     return p_new[:rows], m_new[:rows], v_new[:rows]

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils.math import round_up_to_multiple
+from apex_tpu.utils.pallas import dimsem as _dimsem
 from apex_tpu.utils.platform import pallas_interpret
 
 Shape = Union[int, Sequence[int]]
@@ -40,12 +41,6 @@ Shape = Union[int, Sequence[int]]
 _LANE = 128
 _SUBLANE = 8
 
-
-def _dimsem(*sem):
-    """Grid dimension semantics: 'parallel' lets Mosaic pipeline blocks
-    without ordering constraints (measured ~12% on the flash kernels);
-    accumulating grids must stay 'arbitrary'."""
-    return pltpu.CompilerParams(dimension_semantics=sem)
 # VMEM working-set budget for choosing the row tile. A tile touches ~6 fp32
 # row-blocks (x, y, dy, dx, xhat temp, wdy temp) at H columns each.
 _VMEM_BUDGET = 8 * 1024 * 1024

@@ -15,20 +15,14 @@ from apex_tpu.transformer import parallel_state as ps
 def _axis_is_bound(name: str) -> bool:
     """True iff ``name`` is a mapped axis in the current trace context.
 
-    Prefers the axis-env query (private module, hasattr-gated); falls back
-    to probing with a throwaway psum, whose unbound-axis failure is a
-    trace-time error — either way this resolves while tracing, so no
+    Probes with the PUBLIC ``lax.axis_size`` (pure trace-time metadata —
+    unlike the earlier private ``jax._src.core.get_axis_env`` query or a
+    throwaway-psum probe, it adds nothing to the jaxpr and touches no
+    internals). The unbound case is a trace-time ``NameError``, so no
     runtime branch is compiled.
     """
     try:
-        from jax._src import core as _core
-        env = _core.get_axis_env()
-        if hasattr(env, "axis_exists"):
-            return bool(env.axis_exists(name))
-    except Exception:
-        pass
-    try:
-        lax.psum(jnp.int32(0), name)
+        lax.axis_size(name)
         return True
     except NameError:
         # the unbound-axis trace error; anything else must propagate —

@@ -35,7 +35,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils.math import round_up_to_multiple
-from apex_tpu.utils.pallas import NEG_INF as _NEG, pad_axis as _pad_axis
+from apex_tpu.utils.pallas import (
+    NEG_INF as _NEG,
+    dimsem as _dimsem,
+    pad_axis as _pad_axis,
+)
 from apex_tpu.utils.platform import pallas_interpret
 
 def _block(s_padded: int, max_block: int = 512) -> int:
@@ -75,8 +79,7 @@ def _cparams():
     are parallel, the innermost accumulates into scratch."""
     if not _DIM_SEMANTICS:
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return _dimsem("parallel", "parallel", "arbitrary")
 
 
 def _hash_keep(qpos, kpos, head, seed_lo, seed_hi, rate):

@@ -23,6 +23,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.utils.math import cdiv, round_up_to_multiple
+from apex_tpu.utils.pallas import dimsem as _dimsem
 from apex_tpu.utils.platform import pallas_interpret
 
 _MASK_VALUE = -10000.0  # the reference kernels' masked-score constant
@@ -89,6 +90,7 @@ def _bwd_call(y3, dy3, scale, interpret):
         in_specs=[_smem(), _row_specs(tile, sk), _row_specs(tile, sk)],
         out_specs=_row_specs(tile, sk),
         out_shape=jax.ShapeDtypeStruct(yp.shape, y3.dtype),
+        compiler_params=_dimsem("parallel", "parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, yp, dyp)
     return dx[:, :q]
@@ -115,6 +117,7 @@ def _sms_fwd(x, mask, scale, interpret):
         in_specs=[_smem(), _row_specs(tile, sk), mask_spec],
         out_specs=_row_specs(tile, sk),
         out_shape=jax.ShapeDtypeStruct(xp.shape, x.dtype),
+        compiler_params=_dimsem("parallel", "parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, xp, mp)
     return y[:, :sq].reshape(b, np_, sq, sk)
@@ -161,6 +164,7 @@ def _sut_fwd(x3, scale, interpret):
         in_specs=[_smem(), _row_specs(tile, sk)],
         out_specs=_row_specs(tile, sk),
         out_shape=jax.ShapeDtypeStruct(xp.shape, x3.dtype),
+        compiler_params=_dimsem("parallel", "parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, xp)
     return y[:, :sq]

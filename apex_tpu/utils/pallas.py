@@ -25,3 +25,16 @@ def pad_axis(x, size: int, axis: int, value=0.0):
 def pad2(x, rows: int, cols: int, value=0.0):
     """Pad a 2-D array to (rows, cols)."""
     return pad_axis(pad_axis(x, rows, 0, value), cols, 1, value)
+
+
+def dimsem(*sem):
+    """``pltpu.CompilerParams`` with grid dimension semantics:
+    ``"parallel"`` = revisit-free tiles Mosaic may pipeline/partition
+    freely (measured ~12% on the flash kernels); any dim that
+    accumulates into scratch or a revisited output block MUST stay
+    ``"arbitrary"`` — on megacore parts a ``"parallel"`` dim may be
+    split across TensorCores, and a shared revisited output would lose
+    one core's partial writes."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(dimension_semantics=sem)

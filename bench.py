@@ -124,21 +124,31 @@ def bench_layer_norm(on_tpu):
     rows = 8192 if on_tpu else 64
     for h in (1024, 4096):
         x = jax.random.normal(jax.random.PRNGKey(0), (rows, h), jnp.bfloat16)
-        w = jnp.ones((h,), jnp.float32)
+        # |w| < 1 makes the dy -> dx chain strictly contracting (LN's
+        # input-grad is a projection scaled by w·rstd), so the raw dx
+        # can feed the next iteration's dy with NO normalization pass:
+        # the body moves exactly the 5 streams the GB/s model counts.
+        # Values decay toward zero; TPU arithmetic is value-independent,
+        # so timing is unaffected and the chain stays data-dependent.
+        w = jnp.full((h,), 0.9, jnp.float32)
         b = jnp.zeros((h,), jnp.float32)
+        dy0 = jax.random.normal(jax.random.PRNGKey(1), (rows, h),
+                                jnp.bfloat16)
 
-        def body(x, h=h):
-            # x -> dLN/dx of sum(LN(x)^2): one fwd + one bwd per iter;
-            # the output is O(1)-bounded (xhat is normalized) so the
-            # chain can't blow up, yet stays data-dependent (no hoisting)
-            g = jax.grad(lambda x: jnp.sum(fused_layer_norm_affine(
-                x, w, b, h, 1e-5).astype(jnp.float32) ** 2))(x)
-            return g.astype(jnp.bfloat16)
+        def body(dy, h=h):
+            # Training-shaped workload (changed r4): fwd + bwd with an
+            # EXTERNAL cotangent dy, as an upstream layer supplies.
+            # Rounds 1-3 measured grad(sum(LN(x)^2)) — a self-cotangent
+            # body whose dy = 2y fuses away; numbers are not comparable
+            # across that change.
+            return jax.grad(
+                lambda x: jnp.sum(
+                    fused_layer_norm_affine(x, w, b, h, 1e-5).astype(
+                        jnp.float32) * dy.astype(jnp.float32)))(x)
 
-        # M sized so the 4M-iteration delta (~0.1 ms/iter · 1600) is far
-        # above the axon relay's ~±20 ms dispatch noise; M=50 measured
-        # 0.0 for h=1024 (delta inside noise)
-        dt = timed(body, x, lambda s: jnp.sum(s.astype(jnp.float32)),
+        # M sized so the 4M-iteration delta is far above the axon
+        # relay's ~±20 ms dispatch noise
+        dt = timed(body, dy0, lambda s: jnp.sum(s.astype(jnp.float32)),
                    M=400 if on_tpu else 2)
         # bytes: read x (fwd) + read x,dy (bwd) + write y, dx ~ 5 * 2B
         gbps = 5 * rows * h * 2 / dt / 1e9
@@ -248,9 +258,10 @@ def bench_ddp_bert(on_tpu):
 
     n = jax.device_count()
     cfg = bert_large() if on_tpu else bert_tiny()
-    # b=16/chip is the measured no-remat HBM ceiling for BERT-Large amp
-    # O2 on v5e (b=32 ResourceExhausted); 347 samples/s/chip at b=16
-    per_dev_batch, seq = (16, 128) if on_tpu else (2, 64)
+    # b=24/chip: fits without remat and amortizes the HBM-bound fixed
+    # work (optimizer + master-weight traffic) — the measured headline
+    # winner (b=32 ResourceExhausted without remat; see bench_headline)
+    per_dev_batch, seq = (24, 128) if on_tpu else (2, 64)
     batch = per_dev_batch * n
     mesh = Mesh(jax.devices(), ("data",))
     train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
@@ -280,10 +291,28 @@ def bench_tp_gpt(on_tpu):
     except ImportError:
         return  # GPT lands later this round
     n = jax.device_count()
-    body, init, fetch, batch = gpt_tp_bench(on_tpu, n)
-    dt = timed(body, init, fetch, M=5 if on_tpu else 2)
-    emit(f"gpt_tp{n}_step", batch / dt, "samples/sec",
-         extra={"devices": n, "step_ms": round(dt * 1e3, 2)})
+    # sweep batch/remat like the BERT headline: the fixed memory-bound
+    # work (optimizer on ~350M fp32 params) amortizes over the batch
+    configs = [(8, False), (16, False), (16, True)] if on_tpu \
+        else [(None, False)]
+    best = None
+    for batch, remat in configs:
+        try:
+            body, init, fetch, b = gpt_tp_bench(on_tpu, n, batch=batch,
+                                                remat=remat)
+            dt = timed(body, init, fetch, M=5 if on_tpu else 2)
+        except Exception as e:
+            print(json.dumps({"metric": f"gpt_b{batch}_remat{remat}",
+                              "error": repr(e)[:160]}), flush=True)
+            continue
+        if best is None or b / dt > best[0]:
+            best = (b / dt, b, remat, dt)
+    if best is None:
+        raise RuntimeError("every GPT bench config failed (see above)")
+    sps, b, remat, dt = best
+    emit(f"gpt_tp{n}_step", sps, "samples/sec",
+         extra={"devices": n, "batch": b, "remat": remat,
+                "step_ms": round(dt * 1e3, 2)})
 
 
 # -- flash-attention microbench: kernel vs unfused at long seq --------------

@@ -34,7 +34,7 @@ from apex_tpu.utils.metrics import AverageMeter, Throughput  # noqa: E402
 def parse_args():
     p = argparse.ArgumentParser(description="TPU imagenet example")
     p.add_argument("--arch", "-a", default="resnet50",
-                   choices=["resnet18", "resnet34", "resnet50"])
+                   choices=["resnet10", "resnet18", "resnet34", "resnet50"])
     p.add_argument("-b", "--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)

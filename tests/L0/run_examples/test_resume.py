@@ -14,7 +14,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                     "..", "..", ".."))
 SCRIPT = os.path.join(REPO, "examples", "imagenet", "main_amp.py")
 
-ARGS = ["-a", "resnet18", "--image-size", "32", "--num-classes", "10",
+ARGS = ["-a", "resnet10", "--image-size", "32", "--num-classes", "10",
         "-b", "8", "--print-freq", "1", "--opt-level", "O2"]
 
 

@@ -22,6 +22,18 @@ jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
     jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache: the suite's wall time is dominated by XLA
+# compiles on this host's single CPU core, and most test programs are
+# identical run to run — cache them so iterating on one module doesn't
+# recompile the world. Exported to the environment too, so the
+# subprocess-driving tests (examples, graft entry) inherit it.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 
 @pytest.fixture(autouse=True)
 def _reset_parallel_state():
