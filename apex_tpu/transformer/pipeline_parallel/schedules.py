@@ -13,29 +13,37 @@ SPMD model the whole schedule is instead ONE jitted program:
 - stage parameters are **stacked on a leading axis and sharded over the
   ``pipe`` mesh axis** (each device holds its stage's slice);
 - the microbatch loop is a ``lax.scan`` over "ticks"; at every tick each
-  device applies its stage and the activations rotate one stage forward
-  via ``lax.ppermute`` (``p2p_communication.send_forward_recv_forward``);
-- the backward pipeline is NOT hand-written: the schedule's forward is
-  differentiated with ``jax.value_and_grad``, and the transpose of a
-  ppermute-rotation scan *is* the reversed rotation scan — XLA's
-  latency-hiding scheduler overlaps the resulting collectives with
-  compute exactly where the reference overlaps NCCL with CUDA streams;
-- 1F1B's raison d'être — bounding live activation memory — is served by
-  ``jax.checkpoint`` around the per-tick stage application
-  (``checkpoint_stages=True``): live memory is one hidden state per tick
-  plus rematerialization, the analogue of the reference's
-  ``deallocate_output_tensor`` discipline.
+  device runs ONE forward microbatch (activations rotate +1 via
+  ``lax.ppermute``) AND one backward microbatch (cotangents rotate -1)
+  — true 1F1B steady state in a single uniform tick;
+- the backward IS hand-written, with ``jax.vjp`` inside the tick: stage
+  inputs are kept in a depth-``2*pp-1`` circular buffer and the
+  backward recomputes the stage forward from the saved input (the
+  activation-recompute discipline the reference pairs with 1F1B), so
+  the scan itself is never differentiated and **live activation memory
+  is O(pp × microbatch), independent of the number of microbatches** —
+  the ``deallocate_output_tensor`` property, asserted on compiled HLO by
+  ``tests/L0/run_transformer/test_pipeline_memory.py``;
+- grad/loss accumulators ride the scan carry in fp32.
 
-Bubble accounting: the plain schedule runs ``M + pp - 1`` ticks for
-``M`` microbatches — the same fill/drain bubble as 1F1B.  The
-interleaved schedule uses ``vpp`` lanes per device (virtual chunks
-round-robin over stages, chunk ``c`` on device ``c % pp``) and runs
-``M + pp*vpp - 1`` ticks; each tick computes all resident lanes, so in
-steady state utilization matches the reference while fill/drain is
-``vpp``× longer in tick-count (ticks are the same stage-size — see the
-module docstring of ``p2p_communication`` for why SPMD prefers uniform
-ticks).  Grads and losses are bit-for-bit the same math as the
-reference's schedules.
+Bubble accounting: the plain schedule runs ``M + 2(pp-1)`` ticks for
+``M`` microbatches — the same fill/steady/drain span as 1F1B (fill
+``pp-1``, drain ``pp-1``).  The interleaved schedule uses ``vpp`` lanes
+per device (virtual chunks round-robin over stages, chunk ``c`` on
+device ``c % pp``) and runs ``M + 2(pp*vpp - 1)`` ticks; each tick
+computes all resident lanes, so in steady state utilization matches the
+reference (ticks are the same stage-size — see the module docstring of
+``p2p_communication`` for why SPMD prefers uniform ticks).  Grads and
+losses are bit-for-bit the same math as the reference's schedules.
+
+On fill/drain "garbage" compute: during the bubble every stage runs its
+tick body on masked data where the reference's ranks sit idle.  This is
+deliberate — each stage is its own chip, so the garbage tick costs ZERO
+wall-clock (the pipeline advances at one tick per step either way; the
+bubble's cost is the tick COUNT, identical to the reference's 1F1B
+bubble), and it keeps the scan body branch-free.  Gating the stage
+behind per-device ``lax.cond`` would save only energy, at the price of
+divergent control flow around the TP collectives inside ``stage_fn``.
 
 Model contract (the functional analogue of the reference's
 ``forward_step_func(batch, model)`` protocol):

@@ -296,7 +296,12 @@ def bench_tp_gpt(on_tpu):
     configs = [(8, False), (16, False), (16, True)] if on_tpu \
         else [(None, False)]
     best = None
+    body = init = fetch = None
     for batch, remat in configs:
+        # drop the previous config's sharded train state (params + Adam
+        # m/v, ~4 GB fp32 for gpt_medium) BEFORE allocating the next, or
+        # the doubled residency turns later configs into spurious OOMs
+        body = init = fetch = None
         try:
             body, init, fetch, b = gpt_tp_bench(on_tpu, n, batch=batch,
                                                 remat=remat)
@@ -381,7 +386,11 @@ def bench_headline(on_tpu):
     configs = [(16, False), (24, False), (32, True)] if on_tpu \
         else [(2, False)]
     best = None
+    train_step = state = init = None
     for batch, remat in configs:
+        # release the previous config's train state before allocating
+        # the next (see bench_tp_gpt)
+        train_step = state = init = None
         cfg = dataclasses.replace(base, remat=remat)
         train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
 

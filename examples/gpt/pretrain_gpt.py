@@ -34,6 +34,7 @@ from apex_tpu.optimizers import FusedAdam  # noqa: E402
 from apex_tpu.transformer import parallel_state as ps  # noqa: E402
 from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: E402
     forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
 )
 from apex_tpu.transformer.testing import arguments  # noqa: E402
@@ -69,11 +70,12 @@ def main():
         max_position_embeddings=ns.max_position_embeddings,
         sequence_parallel=ns.sequence_parallel,
         gradient_accumulation_fusion=ns.gradient_accumulation_fusion)
+    vpp = ns.virtual_pipeline_model_parallel_size
     model = GPTModel(cfg, tp_size=tp_sz)
     params = init_gpt(jax.random.PRNGKey(ns.seed), cfg)
-    pipe_params = gpt_to_pipeline_params(params, cfg, pp)
+    pipe_params = gpt_to_pipeline_params(params, cfg, pp, vpp)
     pipe_model = gpt_pipeline_model(model)
-    pspecs = gpt_pipeline_partition_specs(cfg)
+    pspecs = gpt_pipeline_partition_specs(cfg, vpp)
 
     if ns.use_distributed_optimizer:
         if tp_sz > 1 or pp > 1:
@@ -104,8 +106,12 @@ def main():
             f"--micro-batch-size {ns.micro_batch_size} (Megatron errors "
             "here too; silent re-sizing would train a different config)")
     M = local_batch // ns.micro_batch_size
-    fwd_bwd = (forward_backward_pipelining_without_interleaving if pp > 1
-               else forward_backward_no_pipelining)
+    if pp > 1 and vpp:
+        fwd_bwd = forward_backward_pipelining_with_interleaving
+    elif pp > 1:
+        fwd_bwd = forward_backward_pipelining_without_interleaving
+    else:
+        fwd_bwd = forward_backward_no_pipelining
 
     def train_step(p, ostate, batch):
         loss, grads = fwd_bwd(pipe_model, p, batch, num_microbatches=M)
