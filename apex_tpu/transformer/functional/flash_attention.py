@@ -148,7 +148,7 @@ def _needs_mask(causal, pad, qt, kt, bq, bk, nk):
     return needs
 
 
-def _fwd_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref,
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
                 o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, sk, causal, rate, has_mask, pad):
     i, qt, kt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -170,10 +170,12 @@ def _fwd_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref,
 
     def tile(masked):
         def go():
+            # q arrives PRE-SCALED by softmax_scale (folded outside the
+            # kernel — one fewer VPU op per score element; the kernels
+            # are VPU-bound)
             q, k, v = q_ref[0], k_ref[0], v_ref[0]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            s = s * sc_ref[0, 0]
             if masked:
                 valid = _score_mask(
                     s, qt, kt, mask_ref[0, 0, :] if has_mask else None,
@@ -224,7 +226,7 @@ def _fwd_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref,
 
 # -- backward: dq -----------------------------------------------------------
 
-def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                lse_ref, delta_ref, dq_ref, dq_acc, *, sk, causal, rate,
                has_mask, pad):
     i, qt, kt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -241,12 +243,13 @@ def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
     def tile(masked):
         def go():
             q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-            scale = sc_ref[0, 0]
             lse_row = lse_ref[0, 0, pl.ds(qt * bq, bq)]
             delta_row = delta_ref[0, 0, pl.ds(qt * bq, bq)]
+            # q pre-scaled; the kernel emits d(q*scale) and the caller
+            # multiplies the final dq by softmax_scale once
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
             p = jnp.exp(s - lse_row[:, None])
             if masked:
                 valid = _score_mask(
@@ -259,7 +262,7 @@ def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                 keep = _keep_mask(seed_ref, i, qt * bq, kt * bk,
                                   p.shape, rate)
                 dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-            ds = p * (dp - delta_row[:, None]) * scale
+            ds = p * (dp - delta_row[:, None])
             dq_acc[:] += jax.lax.dot_general(
                 ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -283,7 +286,7 @@ def _dq_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
 
 # -- backward: dk, dv -------------------------------------------------------
 
-def _dkv_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                 lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                 *, sk, causal, rate, has_mask, pad):
     i, kt, qt = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -301,12 +304,12 @@ def _dkv_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
     def tile(masked):
         def go():
             q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-            scale = sc_ref[0, 0]
             lse_row = lse_ref[0, 0, pl.ds(qt * bq, bq)]
             delta_row = delta_ref[0, 0, pl.ds(qt * bq, bq)]
+            # q pre-scaled: dk = ds^T @ (scale*q) needs NO adjustment
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)
             p = jnp.exp(s - lse_row[:, None])
             if masked:
                 valid = _score_mask(
@@ -327,8 +330,8 @@ def _dkv_kernel(sc_ref, seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                                      preferred_element_type=jnp.float32)
             if rate > 0.0:
                 dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-            ds = p * (dp - delta_row[:, None]) * scale
-            # dk += ds^T @ q
+            ds = p * (dp - delta_row[:, None])
+            # dk += ds^T @ (scale*q) — the pre-scale supplies the factor
             dk_acc[:] += jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -398,14 +401,20 @@ def _clamp_kt(causal, bq, bk):
     return lambda kt, qt: jnp.minimum(kt, ((qt + 1) * bq - 1) // bk)
 
 
+def _prescale_q(q3, scale):
+    """Fold softmax_scale into q (fp32 multiply, one rounding back to
+    the storage dtype) so no kernel pays a per-score-element scale op."""
+    return (q3.astype(jnp.float32) * jnp.float32(scale)).astype(q3.dtype)
+
+
 def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     q3, k3, v3, m3, sq_p, sk_p, d_p = _prep(q, k, v, mask, b, h)
+    q3 = _prescale_q(q3, scale)
     maxb = _CAUSAL_MAX_BLOCK if (causal and _CAUSAL_SKIP) else 512
     bq, bk = _block(sq_p, maxb), _block(sk_p, maxb)
     grid = (b * h, sq_p // bq, sk_p // bk)
-    sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
     sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
     ckt = _clamp_kt(causal, bq, bk)
     kv_spec = pl.BlockSpec((1, bk, d_p),
@@ -420,7 +429,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
         functools.partial(_fwd_kernel, sk=sk, causal=causal, rate=rate,
                           has_mask=mask is not None, pad=sk != sk_p),
         grid=grid,
-        in_specs=[_smem(), _smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
+        in_specs=[_smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
                   mask_spec],
         out_specs=(_qkv_spec(bq, d_p), row_spec),
         out_shape=(jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
@@ -430,7 +439,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
                         pltpu.VMEM((bq, 128), jnp.float32)],
         compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
-    )(sc, sd, q3, k3, v3, m3)
+    )(sd, q3, k3, v3, m3)
     out = o[:, :sq, :d].reshape(b, h, sq, d)
     return out, lse  # lse stays padded (b*h, 1, sq_p)
 
@@ -440,11 +449,11 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     q3, k3, v3, m3, sq_p, sk_p, d_p = _prep(q, k, v, mask, b, h)
+    q3 = _prescale_q(q3, scale)
     do3 = _pad_axis(_pad_axis(do.reshape(b * h, sq, d), sq_p, 1), d_p, 2)
     o3 = _pad_axis(_pad_axis(out.reshape(b * h, sq, d), sq_p, 1), d_p, 2)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     -1)[:, None, :]  # (bh, 1, sq_p) like lse
-    sc = jnp.asarray(scale, jnp.float32).reshape(1, 1)
     sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
 
     maxb = _CAUSAL_MAX_BLOCK if (causal and _CAUSAL_SKIP) else 512
@@ -462,14 +471,14 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
         functools.partial(_dq_kernel, sk=sk, causal=causal, rate=rate,
                           has_mask=mask is not None, pad=sk != sk_p),
         grid=(b * h, sq_p // bq, sk_p // bk),
-        in_specs=[_smem(), _smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
+        in_specs=[_smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
                   mask_spec, _qkv_spec(bq, d_p), row_spec, row_spec],
         out_specs=_qkv_spec(bq, d_p),
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
         compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
-    )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
+    )(sd, q3, k3, v3, m3, do3, lse_p, delta)
 
     # dkv: k outer / q inner — index maps swap roles; causal clamp
     # mirrors _clamp_kt (q tiles strictly above the diagonal are dead)
@@ -491,7 +500,7 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
         functools.partial(_dkv_kernel, sk=sk, causal=causal, rate=rate,
                           has_mask=mask is not None, pad=sk != sk_p),
         grid=(b * h, sk_p // bk, sq_p // bq),
-        in_specs=[_smem(), _smem(), q_spec2, kv_spec2, kv_spec2, mask_spec2,
+        in_specs=[_smem(), q_spec2, kv_spec2, kv_spec2, mask_spec2,
                   q_spec2, row_spec2, row_spec2],
         out_specs=(kv_spec2, kv_spec2),
         out_shape=(jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
@@ -500,9 +509,11 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
                         pltpu.VMEM((bk, d_p), jnp.float32)],
         compiler_params=_cparams(),
         interpret=pallas_interpret(interpret),
-    )(sc, sd, q3, k3, v3, m3, do3, lse_p, delta)
+    )(sd, q3, k3, v3, m3, do3, lse_p, delta)
 
-    dq = dq[:, :sq, :d].reshape(b, h, sq, d)
+    # dq kernel produced d(scale*q); one fused XLA multiply finishes it
+    dq = (dq[:, :sq, :d].astype(jnp.float32) * jnp.float32(scale)
+          ).astype(q.dtype).reshape(b, h, sq, d)
     dk = dk[:, :sk, :d].reshape(b, h, sk, d)
     dv = dv[:, :sk, :d].reshape(b, h, sk, d)
     return dq, dk, dv
