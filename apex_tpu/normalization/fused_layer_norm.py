@@ -185,6 +185,7 @@ def _bwd_kernel(*refs, mode: str, has_w: bool, has_b: bool,
 # block is MXU/VPU-sized (512×512) regardless of H, which is the point.
 
 _COL_TILE = 512
+_ROW_TILE_CAP = 512  # colsplit row-block cap
 
 
 def _bwd_colsum_kernel(*refs, mode, has_w, has_b):
@@ -265,7 +266,7 @@ def _pad_cols(x2d, h_p):
 def _bwd_call_colsplit(dy2d, x2d, w, mean, rstd, mode, has_b, interpret):
     rows, h = x2d.shape
     tc = _COL_TILE
-    tr = min(512, round_up_to_multiple(rows, _SUBLANE))
+    tr = min(_ROW_TILE_CAP, round_up_to_multiple(rows, _SUBLANE))
     has_w = w is not None
     h_p = round_up_to_multiple(h, tc)
     xp, padded = _pad_rows(_pad_cols(x2d, h_p), tr)
