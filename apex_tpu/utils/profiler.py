@@ -22,6 +22,11 @@ Typical use::
 """
 
 import contextlib
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional
 
 import jax
 
@@ -37,3 +42,75 @@ def trace(log_dir: str, *, create_perfetto_link: bool = False):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# Trace report — the parse-and-report half of the reference's pyprof
+# (``apex/pyprof`` annotated with nvtx AND parsed nsys output into op
+# tables; annotate+trace alone is only half the workflow). jax writes a
+# chrome-trace JSON next to the xplane file; stdlib parsing keeps the
+# report dependency-free (no tensorboard install needed on the pod).
+# ---------------------------------------------------------------------------
+
+
+def summarize_trace(log_dir: str, *, top: int = 20,
+                    device_only: bool = True) -> List[Dict]:
+    """Aggregate the newest trace under ``log_dir`` into per-op totals.
+
+    Returns rows ``{"name", "process", "count", "total_us", "avg_us"}``
+    sorted by total duration, descending. ``device_only`` keeps only
+    device lanes (``/device:...`` processes — XLA ops as executed);
+    pass False to include host-side Python events. Works on any trace
+    written by :func:`trace` / ``jax.profiler.trace``.
+    """
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile",
+                                         "*")))
+    if not runs:
+        raise FileNotFoundError(f"no profile runs under {log_dir}")
+    paths = glob.glob(os.path.join(runs[-1], "*.trace.json.gz"))
+    if not paths:
+        raise FileNotFoundError(
+            f"profile run {runs[-1]} has no *.trace.json.gz (this jax "
+            "build wrote only the xplane file — open it with "
+            "tensorboard/xprof instead)")
+    agg: Dict[tuple, Dict] = {}
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            doc = json.load(f)
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        pids = {e["pid"]: e.get("args", {}).get("name", str(e["pid"]))
+                for e in events
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            proc = pids.get(e.get("pid"), str(e.get("pid")))
+            if device_only and "/device" not in proc:
+                continue
+            key = (proc, e["name"].lstrip("$"))
+            row = agg.setdefault(key, {"name": key[1], "process": proc,
+                                       "count": 0, "total_us": 0.0})
+            row["count"] += 1
+            row["total_us"] += float(e["dur"])
+    if not agg and device_only:
+        raise ValueError(
+            "trace has no device lanes (CPU-only traces record host "
+            "events only) — pass device_only=False to summarize host "
+            "Python/dispatch events")
+    rows = sorted(agg.values(), key=lambda r: -r["total_us"])[:top]
+    for r in rows:
+        r["avg_us"] = r["total_us"] / max(r["count"], 1)
+    return rows
+
+
+def print_summary(log_dir: str, *, top: int = 20,
+                  device_only: bool = True,
+                  file: Optional[object] = None) -> None:
+    """Print :func:`summarize_trace` as a fixed-width table (the
+    pyprof-style report)."""
+    rows = summarize_trace(log_dir, top=top, device_only=device_only)
+    print(f"{'total_us':>12} {'avg_us':>10} {'count':>7}  name",
+          file=file)
+    for r in rows:
+        print(f"{r['total_us']:>12.1f} {r['avg_us']:>10.1f} "
+              f"{r['count']:>7d}  {r['name'][:90]}", file=file)

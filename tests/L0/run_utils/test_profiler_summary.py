@@ -1,0 +1,47 @@
+"""Trace summarizer (the parse-and-report half of the reference's
+pyprof workflow — SURVEY §5 tracing row)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.profiler import (
+    annotate, print_summary, summarize_trace, trace,
+)
+
+
+def test_trace_and_summarize(tmp_path):
+    d = str(tmp_path / "tb")
+
+    @jax.jit
+    def step(x):
+        with annotate("matmul_region"):
+            return x @ x
+
+    x = jnp.ones((128, 128))
+    step(x).block_until_ready()  # compile outside the trace
+    with trace(d):
+        step(x).block_until_ready()
+
+    # CPU backend traces host lanes only — device_only=False covers it
+    rows = summarize_trace(d, top=10, device_only=False)
+    assert rows and all(r["total_us"] > 0 for r in rows)
+    assert all(set(r) >= {"name", "process", "count", "total_us",
+                          "avg_us"} for r in rows)
+
+    buf = io.StringIO()
+    print_summary(d, top=5, device_only=False, file=buf)
+    out = buf.getvalue()
+    assert "total_us" in out and len(out.splitlines()) >= 2
+
+
+def test_device_only_on_host_trace_raises(tmp_path):
+    import pytest
+
+    d = str(tmp_path / "tb2")
+    x = jnp.ones((64, 64))
+    with trace(d):
+        (x @ x).block_until_ready()
+    with pytest.raises(ValueError, match="device_only=False"):
+        summarize_trace(d)  # CPU trace has no device lanes
