@@ -198,3 +198,30 @@ def test_1f1b_two_collective_permutes_per_tick():
     )
     c = _counts(fn, params, batch)
     assert c["collective-permute"] == 2, c
+
+
+def test_ring_attention_rotates_only():
+    """Context-parallel ring attention: K/V rotate via exactly two
+    collective-permutes (the scan body appears once in HLO) and NOTHING
+    is ever gathered — no rank holds the full sequence. Backward adds
+    only the mirrored rotation. Ref: SURVEY §2c ring-attention row
+    (beyond-reference capability)."""
+    ps.initialize_model_parallel(context_parallel_size_=TP)
+    from apex_tpu.transformer.context_parallel import ring_attention
+
+    b, h, s, d = 2, 2, 8, 8  # s is GLOBAL: one token per rank at cp=8
+    # (only the collective structure is pinned here; multi-token ring
+    # blocks are covered by the CP parity tests in run_models/test_gpt)
+    q = jnp.ones((b, h, s, d), jnp.float32)
+    spec = P(None, None, ps.CONTEXT_AXIS)
+
+    fwd = ps.shard_map(
+        lambda q: ring_attention(q, q, q, causal=True),
+        in_specs=spec, out_specs=spec)
+    c = _counts(fwd, q)
+    assert c["collective-permute"] == 2, c
+    assert c["all-gather"] == 0 and c["all-reduce"] == 0, c
+
+    cg = _counts(jax.grad(lambda q: jnp.sum(fwd(q) ** 2)), q)
+    assert cg["collective-permute"] == 4, cg
+    assert cg["all-gather"] == 0 and cg["all-reduce"] == 0, cg
