@@ -74,6 +74,11 @@ class GPTConfig:
     # ``model``). Mutually exclusive with sequence_parallel (different
     # axes, different contracts).
     context_parallel: bool = False
+    # which long-context attention runs under context_parallel:
+    # "ring" rotates k/v shards (O(cp) permutes, any head count) or
+    # "ulysses" all-to-alls seq<->heads (O(1) collectives, needs
+    # (num_heads/tp) % cp == 0) — both exact, tested for parity
+    context_parallel_impl: str = "ring"
     # per-layer fp32 wgrad emission (the gradient_accumulation_fusion
     # analogue, ref fused_weight_gradient_mlp_cuda): with fp32 master
     # weights + bf16 compute, TP linear wgrads leave each layer at fp32
@@ -212,23 +217,47 @@ def _ring_causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
     positions)."""
     from apex_tpu.transformer.context_parallel import ring_attention
 
+    return _cp_attention(q_k_v, cfg, rope_freqs, ring_attention)
+
+
+def _ulysses_causal_attention(q_k_v: jax.Array, cfg: GPTConfig,
+                              rope_freqs: Optional[jax.Array]
+                              ) -> jax.Array:
+    """Context-parallel attention, Ulysses flavor: RoPE is applied on
+    the local shard (``rope_freqs`` already globally positioned), then
+    one stacked all-to-all gives each rank the FULL sequence for h/cp
+    heads (and one brings the context back)."""
+    from apex_tpu.transformer.context_parallel import ulysses_attention
+
+    return _cp_attention(q_k_v, cfg, rope_freqs, ulysses_attention)
+
+
+def _cp_attention(q_k_v, cfg, rope_freqs, attn_fn):
+    """Shared context-parallel attention body: split the fused qkv,
+    apply RoPE on the local shard, run ``attn_fn``, re-fuse heads."""
     b, s, _ = q_k_v.shape
     hd = cfg.head_dim
     q, k, v = _split_qkv(q_k_v, hd)
     if rope_freqs is not None:
         q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs)
         k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs)
-    ctx = ring_attention(q, k, v, causal=True,
-                         softmax_scale=1.0 / math.sqrt(hd))
+    ctx = attn_fn(q, k, v, causal=True,
+                  softmax_scale=1.0 / math.sqrt(hd))
     return ctx.transpose(0, 2, 1, 3).reshape(b, s, -1)
+
+
+_CP_ATTN = {"ring": _ring_causal_attention,
+            "ulysses": _ulysses_causal_attention}
 
 
 def _block(lp, x, cfg, rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
            dropout_rng=None, ring=False):
     """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x)).
     ``ring`` is an execution-path choice, not config: the unsharded
-    golden model runs the same cfg with plain attention."""
-    attn = _ring_causal_attention if ring else _causal_attention
+    golden model runs the same cfg with plain attention; True selects
+    ``cfg.context_parallel_impl``."""
+    attn = _CP_ATTN[cfg.context_parallel_impl] if ring \
+        else _causal_attention
     with jax.named_scope("attention"):
         att = attn(qkv_fn(lp["qkv"], _ln(lp["ln1"], x,
                                          cfg.layer_norm_eps)),
@@ -313,6 +342,16 @@ class GPTModel:
                 "sequence_parallel and context_parallel are mutually "
                 "exclusive (different axes, different activation "
                 "contracts)")
+        if cfg.context_parallel_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel_impl must be 'ring' or 'ulysses', "
+                f"got {cfg.context_parallel_impl!r}")
+        if cfg.context_parallel and cfg.context_parallel_impl == "ulysses":
+            cp = ps.get_context_parallel_world_size()
+            if (cfg.num_heads // t) % cp:
+                raise ValueError(
+                    f"ulysses context parallelism needs local heads "
+                    f"({cfg.num_heads}//tp{t}) divisible by cp={cp}")
         sp = dict(sequence_parallel_enabled=cfg.sequence_parallel,
                   sequence_parallel_seq_dim=1,  # (b, s, h) layout
                   gradient_accumulation_fusion=

@@ -131,3 +131,55 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     _, l_run, acc = qstate
     out = jnp.where(l_run > 0, acc / jnp.where(l_run > 0, l_run, 1.0), 0.0)
     return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      mask: Optional[jax.Array] = None, *,
+                      causal: bool = False,
+                      softmax_scale: Optional[float] = None,
+                      axis_name: str = ps.CONTEXT_AXIS,
+                      attention_fn=None) -> jax.Array:
+    """Ulysses-style sequence parallelism (DeepSpeed-Ulysses; see
+    PAPERS.md): two ``all_to_all``s swap the sharded dimension so each
+    rank runs EXACT attention over the FULL sequence for ``h/cp`` heads,
+    then swap back. The alternative long-context strategy to
+    :func:`ring_attention` — comm is exactly TWO all-to-alls per call
+    (q/k/v ride one stacked collective in, the output one back; O(1)
+    collectives vs the ring's cp-1 rotations of k/v), at the cost of
+    requiring ``heads % cp == 0``.
+
+    Args:
+      q, k, v: (b, h, s_local, d) — the rank's sequence shard along the
+        ``context`` axis (the same activation contract as ring).
+      mask: optional (b, s_local) key-validity shard (1 = attend); it is
+        all-gathered to the full sequence (tiny next to activations).
+      attention_fn: the full-sequence attention to run per head group;
+        defaults to :func:`...functional.flash_attention.flash_attention`
+        (so the Pallas kernel serves long sequences, the XLA path short
+        ones — the usual dispatch).
+
+    Returns (b, h, s_local, d) in q's dtype.
+    """
+    cp = lax.axis_size(axis_name)
+    b, h, s_loc, d = q.shape
+    if h % cp:
+        raise ValueError(
+            f"ulysses_attention needs heads % cp == 0, got {h} % {cp}")
+    if attention_fn is None:
+        from apex_tpu.transformer.functional.flash_attention import (
+            flash_attention,
+        )
+        attention_fn = flash_attention
+
+    # ONE stacked all-to-all for q/k/v: (3, b, h, s/cp, d) with head
+    # shards scattering over ranks while the sequence gathers
+    qkv = lax.all_to_all(jnp.stack([q, k, v]), axis_name, split_axis=2,
+                         concat_axis=3, tiled=True)
+    qf, kf, vf = qkv[0], qkv[1], qkv[2]
+    full_mask = None if mask is None else \
+        lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = attention_fn(qf, kf, vf, full_mask, causal=causal,
+                       softmax_scale=softmax_scale)
+    # inverse swap: heads gather back, the sequence re-shards
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True).astype(q.dtype)

@@ -150,15 +150,17 @@ def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp, rope):
         rtol=2e-4, atol=2e-5)
 
 
-@pytest.mark.parametrize("use_rope,tp,cp", [
-    (False, 1, 8), (True, 1, 8), (False, 2, 4)])
-def test_context_parallel_matches_unsharded(use_rope, tp, cp):
+@pytest.mark.parametrize("use_rope,tp,cp,impl", [
+    (False, 1, 8, "ring"), (True, 1, 8, "ring"), (False, 2, 4, "ring"),
+    (True, 1, 8, "ulysses"), (False, 2, 4, "ulysses")])
+def test_context_parallel_matches_unsharded(use_rope, tp, cp, impl):
     """Long-context GPT: ids/labels sequence-sharded over the context
-    axis, ring attention inside — loss AND grads must match the
-    unsharded model (incl. composed with tp=2)."""
+    axis, ring OR Ulysses attention inside — loss AND grads must match
+    the unsharded model (incl. composed with tp=2)."""
     cfg = gpt_tiny()
     cfg = type(cfg)(**{**cfg.__dict__, "use_rope": use_rope,
-                       "context_parallel": True})
+                       "context_parallel": True,
+                       "context_parallel_impl": impl})
     ps.initialize_model_parallel(tensor_model_parallel_size_=tp,
                                  context_parallel_size_=cp)
     model = GPTModel(cfg, tp_size=tp)
