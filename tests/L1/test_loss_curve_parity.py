@@ -85,24 +85,48 @@ def test_amp_curve_tracks_fp32(golden_curve, opt_level):
 
 
 def test_gpt_converges():
+    # overfit ONE fixed batch — the unambiguous convergence smoke
+    losses = gpt_curve(None, lr=1e-3, weight_decay=0.0,
+                       batch_key=20_000)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def gpt_curve(compute_dtype, seed=0, lr=5e-4, weight_decay=0.01,
+              batch_key=30_000):
+    """GPT loss curve (fixed-batch overfit) — the decoder-side analogue
+    of the BERT amp-level curves; also backs the convergence smoke."""
     cfg = gpt_tiny()
-    params = init_gpt(jax.random.PRNGKey(0), cfg)
-    opt = FusedAdam(lr=1e-3)
+    params = init_gpt(jax.random.PRNGKey(seed), cfg)
+    opt = FusedAdam(lr=lr, weight_decay=weight_decay)
     opt_state = opt.init(params)
 
     @jax.jit
     def step(params, opt_state, ids):
         loss, grads = jax.value_and_grad(
-            lambda p: gpt_loss_unsharded(p, cfg, ids, ids))(params)
+            lambda p: gpt_loss_unsharded(p, cfg, ids, ids,
+                                         compute_dtype=compute_dtype))(
+            params)
         params, opt_state = opt.step(grads, params, opt_state)
         return params, opt_state, loss
 
-    # overfit ONE fixed batch — the unambiguous convergence smoke
-    ids = jax.random.randint(jax.random.PRNGKey(20_000), (4, 32), 0,
-                             cfg.vocab_size)
+    # one FIXED batch (overfit) so the learning assertion is unambiguous
+    ids = jax.random.randint(jax.random.PRNGKey(batch_key), (4, 32),
+                             0, cfg.vocab_size)
     losses = []
     for _ in range(STEPS):
         params, opt_state, loss = step(params, opt_state, ids)
         losses.append(float(loss))
-    assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0] - 0.5, losses
+    return np.array(losses)
+
+
+def test_gpt_bf16_curve_tracks_fp32():
+    """bf16 compute over fp32 master weights (the O2-shaped GPT recipe
+    used by the TP bench) must track the fp32 curve — the L1 guarantee
+    for the decoder stack, incl. the fused xentropy loss path."""
+    fp32 = gpt_curve(None)
+    bf16 = gpt_curve(jnp.bfloat16)
+    assert np.all(np.isfinite(bf16))
+    np.testing.assert_allclose(bf16, fp32, rtol=0.05)
+    assert bf16[-1] < bf16[0] - 0.1       # actually learning
+    assert np.any(bf16 != fp32)           # reduced precision really ran
