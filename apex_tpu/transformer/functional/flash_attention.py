@@ -216,11 +216,13 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
         safe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = jnp.where(l > 0, acc_ref[:] / safe, 0.0).astype(
             o_ref.dtype)
-        # lse row lives at column offset qt*TILE of the (1, 1, sq_p)
-        # full-row block (TPU block rules: last two dims must divide
-        # (8, 128) or equal the array dims — the singleton axis does)
-        bq = q_ref.shape[1]
-        lse_ref[0, 0, pl.ds(qt * bq, bq)] = jnp.where(
+        # lse block is (1, 1, bq) indexed BY qt — each qt owns its own
+        # output block, so qt can stay 'parallel' in dimension_semantics
+        # without megacore cores clobbering each other's slices of a
+        # shared full-row block (a (1,1,sq_p) block indexed (i,0,0) is
+        # revisited across qt; on v4/v5p each TensorCore's private copy
+        # would lose the other core's rows on write-back)
+        lse_ref[0, 0, :] = jnp.where(
             l[:, 0] > 0, m_ref[:, 0] + jnp.log(l[:, 0]), jnp.inf)
 
 
@@ -423,7 +425,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
     mask_spec = pl.BlockSpec((1, 1, bk),
                              lambda i, qt, kt: (i // h, 0, ckt(kt, qt)),
                              memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, 1, sq_p), lambda i, qt, kt: (i, 0, 0),
+    lse_spec = pl.BlockSpec((1, 1, bq), lambda i, qt, kt: (i, 0, qt),
                             memory_space=pltpu.VMEM)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sk=sk, causal=causal, rate=rate,
@@ -431,7 +433,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
         grid=grid,
         in_specs=[_smem(), _qkv_spec(bq, d_p), kv_spec, kv_spec,
                   mask_spec],
-        out_specs=(_qkv_spec(bq, d_p), row_spec),
+        out_specs=(_qkv_spec(bq, d_p), lse_spec),
         out_shape=(jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
                    jax.ShapeDtypeStruct((b * h, 1, sq_p), jnp.float32)),
         scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32),

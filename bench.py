@@ -52,7 +52,7 @@ def _recorded_values(metric):
         candidates = [parsed] if isinstance(parsed, dict) else list(parsed)
         for c in candidates:
             if isinstance(c, dict) and c.get("metric") == metric \
-                    and c.get("value"):
+                    and c.get("value") is not None:
                 vals.append(c["value"])
     return vals
 
@@ -61,7 +61,9 @@ def emit(metric, value, unit, extra=None, higher_is_better=True):
     """vs_baseline compares to the LATEST recorded round; vs_best to the
     best round EVER, so a regression-after-a-regression can't report >1
     (round-3 verdict weak #8). Both >1 = this run is better."""
-    prior = _recorded_values(metric)
+    # drop zeros: a recorded 0 (failed round, or rounded-to-0.0 tiny
+    # value) would be a zero denominator in the ratios below
+    prior = [v for v in _recorded_values(metric) if v]
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
            "vs_baseline": None}
     if prior:
