@@ -38,8 +38,11 @@ BERT_LARGE_PARAMS = 336e6  # ≈ param count incl. embeddings
 
 
 def _recorded_values(metric):
-    """All recorded values for `metric` from driver BENCH_r*.json files
-    (the driver nests the printed line under "parsed"), oldest first."""
+    """All recorded values for `metric` from driver BENCH_r*.json files,
+    oldest first, one value per round. The driver nests only the LAST
+    printed line under "parsed" but keeps the full stdout tail under
+    "tail" — parse both, or every metric except the tail one loses its
+    history (r4's vs_baseline was null for all but one metric)."""
     vals = []
     runs = sorted(glob.glob(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
@@ -50,10 +53,19 @@ def _recorded_values(metric):
             continue
         parsed = rec.get("parsed") or {}
         candidates = [parsed] if isinstance(parsed, dict) else list(parsed)
+        for ln in (rec.get("tail") or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    candidates.append(json.loads(ln))
+                except ValueError:
+                    pass
+        run_val = None  # last occurrence in this round wins
         for c in candidates:
             if isinstance(c, dict) and c.get("metric") == metric \
                     and c.get("value") is not None:
-                vals.append(c["value"])
+                run_val = c["value"]
+        if run_val is not None:
+            vals.append(run_val)
     return vals
 
 
@@ -78,44 +90,103 @@ def emit(metric, value, unit, extra=None, higher_is_better=True):
     print(json.dumps(rec), flush=True)
 
 
-def timed(body, init_state, fetch, M, K=4):
+def timed(body, init_state, fetch, M, K=4, donate=False):
     """Median seconds per iteration of ``body`` (state -> state, a pytree
     step function), measured by DIFFERENCING two scan-chunk lengths.
 
     The axon relay imposes a ~100 ms fixed cost on every dispatch+fetch
     cycle regardless of the work inside (measured: 50 fused multiplies of
     a 16 MB array and a single one both take ~100 ms end to end), and
-    ``block_until_ready`` is not a reliable sync, so: run the body M and
-    5M times inside single jitted ``lax.scan`` chunks, end each in a
-    ``float()`` fetch of a chunk-dependent scalar, and report
-    (t(5M) - t(M)) / 4M — the fixed overhead cancels exactly. Sanity
-    anchor: this methodology reproduces the v5e bf16 peak (197 TFLOP/s)
-    on a 4096^3 matmul chain."""
-    M1, M2 = M, 5 * M
+    ``block_until_ready`` is not a reliable sync, so: jit ONE M-step
+    ``lax.scan`` chunk, run it 1x and 5x (chained, async dispatch), end
+    each measurement in a ``float()`` fetch of a chunk-dependent scalar,
+    and report (t(5 calls) - t(1 call)) / 4M — the fixed overhead
+    cancels exactly. Sanity anchor: the two-program ancestor of this
+    methodology reproduces the v5e bf16 peak (197 TFLOP/s) on a 4096^3
+    matmul chain, and this variant matches it on the Adam bench.
 
-    def chunk_fn(length):
-        @jax.jit
-        def chunk(state):
-            def f(s, _):
-                return body(s), ()
-            s, _ = jax.lax.scan(f, state, None, length=length)
-            return s
-        return chunk
+    ``donate=True`` changes the state protocol: ``init_state`` must be a
+    ZERO-ARG FACTORY, each chunk donates its input, and the state
+    threads forward across chunks instead of replaying from init. The
+    train state then lives ONCE in HBM — the training-realistic
+    footprint (real steps donate their buffers). The replay protocol
+    keeps init + output alive simultaneously, which is what turned
+    BENCH_r04's b16 GPT configs into spurious ResourceExhausted. Timing
+    is value-independent on TPU, so an evolving state measures the same
+    program the replay did."""
+    def chunk_body(state):
+        def f(s, _):
+            return body(s), ()
+        s, _ = jax.lax.scan(f, state, None, length=M)
+        return s
 
-    c1, c2 = chunk_fn(M1), chunk_fn(M2)
+    chunk = jax.jit(chunk_body, donate_argnums=0) if donate \
+        else jax.jit(chunk_body)
+    box = [init_state() if donate else init_state]
 
-    def t_of(chunk):
-        state = chunk(init_state)
-        float(fetch(state))  # compile + sync
+    # ONE compiled program: the long chunk is 5 CHAINED dispatches of the
+    # same jitted scan, not a separately-compiled 5M-scan. jit dispatch
+    # is async, so the chain runs back-to-back on device and the fetch
+    # syncs once at the end; (t(5 calls) - t(1 call)) / 4M cancels the
+    # relay's fixed dispatch+fetch cost exactly like the two-program
+    # scheme did — validated on the Adam bench (12.56 ms vs the
+    # two-program 11.9-12.6 ms band) — while paying ONE XLA compile.
+    # That matters: the scan-of-50 FusedAdam chunk alone took ~390 s to
+    # compile through the relay, which is what pushed opt_adam past its
+    # config cap in the r5 shakeout run.
+    def run(ncalls):
+        state = chunk(box[0])
+        for _ in range(ncalls - 1):
+            state = chunk(state)
+        if donate:
+            box[0] = state
+        float(fetch(state))
+
+    run(1)  # compile + warm
+
+    def t_of(ncalls):
         ts = []
         for _ in range(K):
             t0 = time.perf_counter()
-            state = chunk(init_state)
-            float(fetch(state))
+            run(ncalls)
             ts.append(time.perf_counter() - t0)
         return statistics.median(ts)
 
-    return max(t_of(c2) - t_of(c1), 1e-9) / (M2 - M1)
+    return max(t_of(5) - t_of(1), 1e-9) / (4 * M)
+
+
+def checked(metric, unit_scale, body, init_state, fetch, M, K=4,
+            donate=False):
+    """``timed`` plus a sanity gate against the metric's own driver
+    history: if the fresh measurement lands >3x off the last
+    driver-recorded value, measure ONCE more and keep the faster run.
+    Relay/allocator damage only ever ADDS time (BENCH_r04: flash seq2048
+    read 27x slow while seq4096 in the same process was healthy), so
+    min() is the honest pick. Returns (dt_seconds, extra) where extra
+    carries the retry provenance for the emitted line."""
+    dt = timed(body, init_state, fetch, M, K, donate=donate)
+    extra = {}
+    prior = [v for v in _recorded_values(metric) if v]
+    if prior:
+        # gate against the BEST prior round: a damaged recorded value
+        # (r4's 94.99 ms flash seq2048) must not poison the gate the
+        # way gating on the latest round would
+        best = min(prior)
+        ratio = dt * unit_scale / best
+        if ratio > 3.0 or ratio < 1.0 / 3.0:
+            first = dt
+            dt = min(dt, timed(body, init_state, fetch, M, K,
+                               donate=donate))
+            extra = {"retried": True,
+                     "first": round(first * unit_scale, 2),
+                     "suspect": dt * unit_scale / best > 3.0}
+    return dt, extra
+
+
+# Driver mode runs ONE measured-winner config per model bench; sweeps
+# (batch x remat) burned BENCH_r04's budget into rc=124 and two OOMs.
+# Set BENCH_SWEEP=1 to re-tune candidates at build time.
+_SWEEP = os.environ.get("BENCH_SWEEP") == "1"
 
 
 # -- config 2: LN microbench ------------------------------------------------
@@ -150,12 +221,14 @@ def bench_layer_norm(on_tpu):
 
         # M sized so the 4M-iteration delta is far above the axon
         # relay's ~±20 ms dispatch noise
-        dt = timed(body, dy0, lambda s: jnp.sum(s.astype(jnp.float32)),
-                   M=400 if on_tpu else 2)
+        name = f"fused_layer_norm_fwdbwd_h{h}"
+        dt, extra = checked(name, 1e6, body, dy0,
+                            lambda s: jnp.sum(s.astype(jnp.float32)),
+                            M=400 if on_tpu else 2)
         # bytes: read x (fwd) + read x,dy (bwd) + write y, dx ~ 5 * 2B
         gbps = 5 * rows * h * 2 / dt / 1e9
-        emit(f"fused_layer_norm_fwdbwd_h{h}", dt * 1e6, "us/iter",
-             extra={"rows": rows, "GBps": round(gbps, 1)},
+        extra.update({"rows": rows, "GBps": round(gbps, 1)})
+        emit(name, dt * 1e6, "us/iter", extra=extra,
              higher_is_better=False)
 
 
@@ -172,24 +245,31 @@ def _make_optimizer(which):
 
 def bench_one_optimizer(which, on_tpu):
     """One optimizer per subprocess: BERT-Large fp32 state doesn't fit
-    twice in HBM (measured ResourceExhausted when chained in-process)."""
+    twice in HBM (measured ResourceExhausted when chained in-process),
+    and the donating timer keeps exactly one copy live."""
     from apex_tpu.models import bert_large, bert_tiny, init_bert
 
     cfg = bert_large() if on_tpu else bert_tiny()
-    params = init_bert(jax.random.PRNGKey(0), cfg)
-    grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), params)
+    # grads from shape metadata only — no second on-device init
+    shapes = jax.eval_shape(
+        lambda: init_bert(jax.random.PRNGKey(0), cfg))
+    grads = jax.tree.map(lambda sd: jnp.full(sd.shape, 1e-4, sd.dtype),
+                         shapes)
     opt = _make_optimizer(which)
-    opt_state = opt.init(params)
+
+    def make_init():
+        params = init_bert(jax.random.PRNGKey(0), cfg)
+        return params, opt.init(params)
 
     def body(state):
         p, s = state
         return opt.step(grads, p, s)
 
-    dt = timed(body, (params, opt_state),
-               lambda s: jnp.sum(s[0]["pooler"]["bias"]),
-               M=10 if on_tpu else 2)
-    emit(f"fused_{which}_step_bert_large_params", dt * 1e3, "ms/step",
-         higher_is_better=False)
+    name = f"fused_{which}_step_bert_large_params"
+    dt, extra = checked(name, 1e3, body, make_init,
+                        lambda s: jnp.sum(s[0]["pooler"]["bias"]),
+                        M=10 if on_tpu else 2, donate=True)
+    emit(name, dt * 1e3, "ms/step", extra=extra, higher_is_better=False)
 
 
 def bench_flat_vs_tree_many_tensors(on_tpu):
@@ -214,24 +294,30 @@ def bench_flat_vs_tree_many_tensors(on_tpu):
             p, s = state
             return opt.step(grads, p, s)
 
-        dt = timed(body, (params, opt_state),
-                   lambda s: jnp.sum(s[0]["t0"]), M=20 if on_tpu else 2)
-        emit(f"fused_adam_{name}_{n}_small_tensors", dt * 1e3, "ms/step",
+        metric = f"fused_adam_{name}_{n}_small_tensors"
+        dt, extra = checked(metric, 1e3, body, (params, opt_state),
+                            lambda s: jnp.sum(s[0]["t0"]),
+                            M=20 if on_tpu else 2)
+        emit(metric, dt * 1e3, "ms/step", extra=extra,
              higher_is_better=False)
 
 
 # -- shared BERT train-step builder ----------------------------------------
 
 def _bert_step(batch, seq, cfg):
+    """Returns (train_step, make_state, (ids, mask)); ``make_state`` is
+    a zero-arg factory so the donating timer holds ONE state copy."""
     from apex_tpu import amp
     from apex_tpu.models import apply_bert, init_bert, mlm_loss
     from apex_tpu.optimizers import FusedAdam
 
     h = amp.initialize(opt_level="O2", loss_scale="dynamic")
-    params = init_bert(jax.random.PRNGKey(0), cfg)
     opt = FusedAdam(lr=1e-4, weight_decay=0.01)
-    opt_state = opt.init(params)
-    scaler_state = h.init_state()
+
+    def make_state():
+        params = init_bert(jax.random.PRNGKey(0), cfg)
+        return params, opt.init(params), h.init_state()
+
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                              cfg.vocab_size)
     mask = jnp.ones((batch, seq), jnp.int32)
@@ -248,7 +334,7 @@ def _bert_step(batch, seq, cfg):
                                      found_inf=found_inf)
         return master, opt_state, scaler_state, loss
 
-    return train_step, (params, opt_state, scaler_state), (ids, mask)
+    return train_step, make_state, (ids, mask)
 
 
 # -- config 4: DDP BERT over all local devices ------------------------------
@@ -260,13 +346,12 @@ def bench_ddp_bert(on_tpu):
 
     n = jax.device_count()
     cfg = bert_large() if on_tpu else bert_tiny()
-    # b=24/chip: fits without remat and amortizes the HBM-bound fixed
-    # work (optimizer + master-weight traffic) — the measured headline
-    # winner (b=32 ResourceExhausted without remat; see bench_headline)
-    per_dev_batch, seq = (24, 128) if on_tpu else (2, 64)
+    # b=64/chip: the measured headline winner under the donating timer
+    # (see bench_headline's sweep record)
+    per_dev_batch, seq = (64, 128) if on_tpu else (2, 64)
     batch = per_dev_batch * n
     mesh = Mesh(jax.devices(), ("data",))
-    train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
+    train_step, make_state, (ids, mask) = _bert_step(batch, seq, cfg)
     # GSPMD DP: batch sharded over the data axis, params replicated —
     # jit propagates the sharding; XLA inserts the grad all-reduce.
     data_sharding = NamedSharding(mesh, P("data", None))
@@ -277,8 +362,8 @@ def bench_ddp_bert(on_tpu):
         m, o, sc, _ = train_step(st[0], st[1], st[2], ids, mask)
         return (m, o, sc, _)
 
-    init = (*state, jnp.float32(0))
-    dt = timed(body, init, lambda s: s[3], M=10 if on_tpu else 2)
+    dt = timed(body, lambda: (*make_state(), jnp.float32(0)),
+               lambda s: s[3], M=10 if on_tpu else 2, donate=True)
     sps = batch / dt / n
     emit(f"bert_ddp_dp{n}_step", sps, "samples/sec/chip",
          extra={"per_device_batch": per_dev_batch, "devices": n,
@@ -293,25 +378,40 @@ def bench_tp_gpt(on_tpu):
     except ImportError:
         return  # GPT lands later this round
     n = jax.device_count()
-    # sweep batch/remat like the BERT headline: the fixed memory-bound
-    # work (optimizer on ~350M fp32 params) amortizes over the batch
-    configs = [(8, False), (16, False), (16, True)] if on_tpu \
-        else [(None, False)]
+    # b=8 + full per-layer remat is the measured winner. r5 swept the
+    # whole surface: b8/b12/b16 x {full remat, dots_saveable selective
+    # remat} all land in 28.8-30.1 samples/s (per-SAMPLE cost rises
+    # with batch), TRUE no-remat crashes the relay's compile helper at
+    # b>=8, and selective remat performs identically to full remat —
+    # the step is not recompute-dominated (see BASELINE.md GPT
+    # roofline). The sweep only runs at build time under BENCH_SWEEP=1.
+    if not on_tpu:
+        configs = [(None, False)]
+    elif _SWEEP:
+        configs = [(8, True), (8, "dots_saveable"), (12, "dots_saveable"),
+                   (16, "dots_saveable")]
+    else:
+        configs = [(8, True)]
     best = None
-    body = init = fetch = None
+    body = make_init = fetch = None
     for batch, remat in configs:
-        # drop the previous config's sharded train state (params + Adam
-        # m/v, ~4 GB fp32 for gpt_medium) BEFORE allocating the next, or
-        # the doubled residency turns later configs into spurious OOMs
-        body = init = fetch = None
+        # drop the previous config's closures BEFORE building the next;
+        # the donating timer already keeps only one live train state
+        body = make_init = fetch = None
         try:
-            body, init, fetch, b = gpt_tp_bench(on_tpu, n, batch=batch,
-                                                remat=remat)
-            dt = timed(body, init, fetch, M=5 if on_tpu else 2)
+            body, make_init, fetch, b = gpt_tp_bench(on_tpu, n,
+                                                     batch=batch,
+                                                     remat=remat)
+            dt = timed(body, make_init, fetch, M=5 if on_tpu else 2,
+                       donate=True)
         except Exception as e:
             print(json.dumps({"metric": f"gpt_b{batch}_remat{remat}",
                               "error": repr(e)[:160]}), flush=True)
             continue
+        if _SWEEP:
+            print(json.dumps({"metric": f"gpt_b{batch}_remat{remat}",
+                              "sweep_samples_per_sec": round(b / dt, 2),
+                              "step_ms": round(dt * 1e3, 2)}), flush=True)
         if best is None or b / dt > best[0]:
             best = (b / dt, b, remat, dt)
     if best is None:
@@ -335,6 +435,7 @@ def bench_flash_attention(on_tpu):
     q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
                for kk in ks)
 
+    kernel_2048_ms = None
     for name, use_kernel in (("kernel", True), ("unfused", False)):
         def body(q, uk=use_kernel):
             g = jax.grad(lambda q: jnp.sum(flash_attention(
@@ -343,12 +444,16 @@ def bench_flash_attention(on_tpu):
             return (g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-6)).astype(
                 q.dtype)
 
-        dt = timed(body, q, lambda x: jnp.sum(x.astype(jnp.float32)),
-                   M=10 if on_tpu else 2)
+        metric = f"flash_attention_{name}_seq{s}_fwdbwd"
+        dt, extra = checked(metric, 1e3, body, q,
+                            lambda x: jnp.sum(x.astype(jnp.float32)),
+                            M=10 if on_tpu else 2)
+        if use_kernel:
+            kernel_2048_ms = dt * 1e3
         # causal attention FLOPs: ~2·(QK + PV + bwd≈2.5x) over s²/2
         flops = 2 * 3.5 * b * h * s * s * d
-        emit(f"flash_attention_{name}_seq{s}_fwdbwd", dt * 1e3, "ms/iter",
-             extra={"tflops": round(flops / dt / 1e12, 1)},
+        extra["tflops"] = round(flops / dt / 1e12, 1)
+        emit(metric, dt * 1e3, "ms/iter", extra=extra,
              higher_is_better=False)
 
     # long-seq causal line (kernel only: materialized scores at 4096 would
@@ -363,12 +468,43 @@ def bench_flash_attention(on_tpu):
             ** 2))(q2)
         return (g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-6)).astype(q2.dtype)
 
-    dt = timed(body2, q2, lambda x: jnp.sum(x.astype(jnp.float32)),
-               M=10 if on_tpu else 2)
+    # d=128 line: the MXU-full datapoint. d=64 fills half the 128-wide
+    # systolic contraction for QK^T / dp=do@v^T; comparing achieved
+    # TFLOPs here against the d=64 line separates "kernel is the
+    # limiter" from "head shape is the limiter".
+    h3, d3 = 8, 128  # same b*h*s*d working set as the d=64 line
+    q3, k3, v3 = (jax.random.normal(kk, (b, h3, s, d3), jnp.bfloat16)
+                  for kk in ks)
+
+    def body3(q3):
+        g = jax.grad(lambda q3: jnp.sum(flash_attention(
+            q3, k3, v3, causal=True, use_kernel=True).astype(jnp.float32)
+            ** 2))(q3)
+        return (g / jnp.maximum(jnp.max(jnp.abs(g)), 1e-6)).astype(q3.dtype)
+
+    metric = f"flash_attention_kernel_seq{s}_d{d3}_fwdbwd"
+    dt, extra = checked(metric, 1e3, body3, q3,
+                        lambda x: jnp.sum(x.astype(jnp.float32)),
+                        M=10 if on_tpu else 2)
+    extra["tflops"] = round(2 * 3.5 * b * h3 * s * s * d3 / dt / 1e12, 1)
+    emit(metric, dt * 1e3, "ms/iter", extra=extra, higher_is_better=False)
+
+    metric = f"flash_attention_kernel_seq{s2}_fwdbwd"
+    dt, extra = checked(metric, 1e3, body2, q2,
+                        lambda x: jnp.sum(x.astype(jnp.float32)),
+                        M=10 if on_tpu else 2)
     flops = 2 * 3.5 * b2 * h * s2 * s2 * d
-    emit(f"flash_attention_kernel_seq{s2}_fwdbwd", dt * 1e3, "ms/iter",
-         extra={"tflops": round(flops / dt / 1e12, 1)},
-         higher_is_better=False)
+    # Cross-metric sanity (BENCH_r04's tell): seq2048 runs HALF of
+    # seq4096's FLOPs (b·s² ratio: 4·2048² vs 2·4096² = 1:2) so its
+    # per-iter time must be LOWER; if not, the seq2048 number was
+    # relay-damaged.
+    if on_tpu and kernel_2048_ms is not None and kernel_2048_ms > dt * 1e3:
+        print(json.dumps({"metric": "flash_sanity_seq2048_vs_seq4096",
+                          "violated": True,
+                          "seq2048_ms": round(kernel_2048_ms, 2),
+                          "seq4096_ms": round(dt * 1e3, 2)}), flush=True)
+    extra["tflops"] = round(flops / dt / 1e12, 1)
+    emit(metric, dt * 1e3, "ms/iter", extra=extra, higher_is_better=False)
 
 
 # -- config 1/headline: BERT-Large pretrain step ----------------------------
@@ -380,35 +516,61 @@ def bench_headline(on_tpu):
 
     base = bert_large() if on_tpu else bert_tiny()
     seq = 128 if on_tpu else 64
-    # b=16 was the assumed no-remat HBM ceiling (b=32 OOMs); b=24 fits
-    # without remat and amortizes the ~17 ms/step of memory-bound fixed
-    # work (optimizer + master-weight traffic — see BASELINE.md roofline)
-    # over 1.5x the samples; remat unlocks b=32 at ~33% fwd recompute.
-    # Measure all three, report the winner.
-    configs = [(16, False), (24, False), (32, True)] if on_tpu \
-        else [(2, False)]
+    # b=64 no-remat is the measured winner (r5 sweep under the donating
+    # timer: b24 402.6 / b32 425.1 / b48 450.5 / b64 461.2 / b96 449.8
+    # samples/s, b32+remat 345.8 — the fixed HBM-bound work amortizes up
+    # to b64, then allocator pressure turns the curve over; b>=32
+    # no-remat only became viable when the timer stopped holding two
+    # train-state copies). Driver mode runs ONLY the winner so the
+    # headline always lands inside the budget; re-tune candidates at
+    # build time with BENCH_SWEEP=1.
+    if not on_tpu:
+        configs = [(2, False)]
+    elif _SWEEP:
+        configs = [(48, False), (64, False), (96, False)]
+    else:
+        configs = [(64, False)]
     best = None
     train_step = state = init = None
+    metric = ("bert_large_pretrain_step_amp_O2_fused_adam"
+              if on_tpu else "bert_tiny_cpu_smoke")
+    extra = {}
     for batch, remat in configs:
-        # release the previous config's train state before allocating
-        # the next (see bench_tp_gpt)
+        # release the previous config's closures before building the
+        # next (the donating timer holds only one live train state)
         train_step = state = init = None
         cfg = dataclasses.replace(base, remat=remat)
-        train_step, state, (ids, mask) = _bert_step(batch, seq, cfg)
+        train_step, make_state, (ids, mask) = _bert_step(batch, seq, cfg)
 
         def body(st, train_step=train_step, ids=ids, mask=mask):
             m, o, sc, loss = train_step(st[0], st[1], st[2], ids, mask)
             return (m, o, sc, loss)
 
-        init = (*state, jnp.float32(0))
+        def init(make_state=make_state):
+            return (*make_state(), jnp.float32(0))
+
         try:
             dt = timed(body, init, lambda s: s[3], M=10 if on_tpu else 2,
-                       K=5)
+                       K=5, donate=True)
+            # sanity gate on the CONTRACT metric: >3x off the last
+            # driver-recorded throughput -> measure once more, keep the
+            # better run (relay damage only subtracts throughput)
+            prior = [v for v in _recorded_values(metric) if v]
+            if prior and not _SWEEP and on_tpu:
+                if not (1 / 3.0 < (batch / dt) / max(prior) < 3.0):
+                    first = batch / dt
+                    dt = min(dt, timed(body, init, lambda s: s[3],
+                                       M=10, K=5, donate=True))
+                    extra = {"retried": True, "first": round(first, 2)}
         except Exception as e:  # OOM at a candidate config: skip it
             print(json.dumps({"metric": f"headline_b{batch}_remat{remat}",
                               "error": repr(e)[:160]}), flush=True)
             continue
         sps = batch / dt
+        if _SWEEP:
+            print(json.dumps({"metric": f"headline_b{batch}_remat{remat}",
+                              "sweep_samples_per_sec": round(sps, 2),
+                              "step_ms": round(dt * 1e3, 2)}), flush=True)
         if best is None or sps > best[0]:
             best = (sps, batch, remat, dt)
     if best is None:
@@ -417,12 +579,192 @@ def bench_headline(on_tpu):
     sps, batch, remat, dt = best
     tflops = 6 * BERT_LARGE_PARAMS * batch * seq / dt / 1e12 if on_tpu \
         else 0.0
-    metric = ("bert_large_pretrain_step_amp_O2_fused_adam"
-              if on_tpu else "bert_tiny_cpu_smoke")
-    emit(metric, sps, "samples/sec/chip",
-         extra={"batch": batch, "seq": seq, "remat": remat,
-                "step_ms": round(dt * 1e3, 2),
-                "tflops": round(tflops, 1)})
+    extra.update({"batch": batch, "seq": seq, "remat": remat,
+                  "step_ms": round(dt * 1e3, 2), "tflops": round(tflops, 1)})
+    emit(metric, sps, "samples/sec/chip", extra=extra)
+
+
+# -- compiled-kernel numerics parity ----------------------------------------
+
+def bench_kernel_parity(on_tpu):
+    """Compiled-Mosaic vs plain-jnp numerics for every Pallas kernel
+    family. CI runs the kernels in interpret mode on the CPU rig (1-core
+    host, no chip), so a Mosaic miscompile would pass the whole suite
+    and first surface as a bad loss — this config closes that hole at
+    driver time by asserting parity ON the chip (round-4 verdict weak
+    #7). Emits one pass/fail line; failures name the check."""
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.normalization import (fused_layer_norm_affine,
+                                        fused_rms_norm_affine)
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.functional import (
+        flash_attention, scaled_masked_softmax,
+        scaled_upper_triang_masked_softmax)
+
+    key = jax.random.PRNGKey(0)
+    results = {}
+
+    def rel(a, b):
+        # PER-LEAF relative error, then max over leaves: a global
+        # denominator would let the large loss scalar (O(1e3)) mask
+        # garbage in O(1) gradient leaves — the exact failure this
+        # parity gate exists to catch
+        a = jax.tree.map(lambda x: x.astype(jnp.float32), a)
+        b = jax.tree.map(lambda x: x.astype(jnp.float32), b)
+        return max(
+            float(jnp.max(jnp.abs(x - y)))
+            / max(float(jnp.max(jnp.abs(y))), 1e-6)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+    def check(name, tol, kernel_fn, ref_fn, *args):
+        got = jax.jit(kernel_fn)(*args)
+        want = jax.jit(ref_fn)(*args)
+        results[name] = (round(rel(got, want), 5), tol)
+
+    # layer norm / rms norm: fwd+bwd at both backward structures (row
+    # path h=1024, column-split path h=4096)
+    for h in (1024, 4096):
+        x = jax.random.normal(key, (256, h), jnp.bfloat16)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (h,), jnp.float32)
+        b = jax.random.normal(jax.random.fold_in(key, 2), (h,), jnp.float32)
+        dy = jax.random.normal(jax.random.fold_in(key, 3), (256, h),
+                               jnp.bfloat16)
+
+        def ln_ref(x, w, b, dy, h=h):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, -1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+            return y.astype(x.dtype)
+
+        def ln_kern(x, w, b, dy, h=h):
+            return fused_layer_norm_affine(x, w, b, h, 1e-5)
+
+        def wrap(f):
+            def g(x, w, b, dy):
+                def loss(x, w, b):
+                    return jnp.sum(f(x, w, b, dy).astype(jnp.float32)
+                                   * dy.astype(jnp.float32))
+                l, grads = jax.value_and_grad(loss, (0, 1, 2))(x, w, b)
+                return (l, *grads)
+            return g
+
+        check(f"ln_h{h}", 3e-2, wrap(ln_kern), wrap(ln_ref), x, w, b, dy)
+
+        def rms_ref(x, w, dy, h=h):
+            xf = x.astype(jnp.float32)
+            ms = jnp.mean(xf ** 2, -1, keepdims=True)
+            return (xf * jax.lax.rsqrt(ms + 1e-5) * w).astype(x.dtype)
+
+        def rms_kern(x, w, dy, h=h):
+            return fused_rms_norm_affine(x, w, h, 1e-5)
+
+        def wrap2(f):
+            def g(x, w, dy):
+                def loss(x, w):
+                    return jnp.sum(f(x, w, dy).astype(jnp.float32)
+                                   * dy.astype(jnp.float32))
+                l, grads = jax.value_and_grad(loss, (0, 1))(x, w)
+                return (l, *grads)
+            return g
+
+        check(f"rms_h{h}", 3e-2, wrap2(rms_kern), wrap2(rms_ref), x, w, dy)
+
+    # flash attention: causal and padding-masked, fwd + dq/dk/dv, kernel
+    # vs the mathematically-identical unfused XLA path
+    b_, h_, s_, d_ = 2, 4, 512, 64
+    ks = jax.random.split(key, 4)
+    q, k, v = (jax.random.normal(kk, (b_, h_, s_, d_), jnp.bfloat16)
+               for kk in ks[:3])
+    pad_mask = (jnp.arange(s_)[None, :] < s_ - 64).astype(jnp.int32)
+    pad_mask = jnp.broadcast_to(pad_mask, (b_, s_))
+
+    def fa(uk, mask, causal):
+        def g(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, mask, causal=causal,
+                    use_kernel=uk).astype(jnp.float32) ** 2)
+            l, grads = jax.value_and_grad(loss, (0, 1, 2))(q, k, v)
+            return (l, *grads)
+        return g
+
+    check("flash_causal", 5e-2, fa(True, None, True),
+          fa(False, None, True), q, k, v)
+    check("flash_masked", 5e-2, fa(True, pad_mask, False),
+          fa(False, pad_mask, False), q, k, v)
+
+    # fused softmax pair vs jnp
+    x4 = jax.random.normal(ks[3], (2, 4, 256, 256), jnp.bfloat16)
+    smask = (jax.random.uniform(ks[3], (2, 1, 256, 256)) < 0.2)
+
+    def sm_ref(x4, smask):
+        s = x4.astype(jnp.float32) * 0.5
+        s = jnp.where(smask, -10000.0, s)
+        return jax.nn.softmax(s, -1).astype(x4.dtype)
+
+    check("softmax_masked", 3e-2,
+          lambda x4, m: scaled_masked_softmax(x4, m, 0.5), sm_ref,
+          x4, smask)
+
+    def sut_ref(x4):
+        s = x4.astype(jnp.float32) * 0.5
+        tri = jnp.arange(256)[None, :] <= jnp.arange(256)[:, None]
+        s = jnp.where(tri[None, None], s, -10000.0)
+        return jax.nn.softmax(s, -1).astype(x4.dtype)
+
+    check("softmax_causal", 3e-2,
+          lambda x4: scaled_upper_triang_masked_softmax(x4, 0.5),
+          sut_ref, x4)
+
+    # fused cross entropy (fwd + dlogits) vs logsumexp reference,
+    # including ignored labels
+    logits = jax.random.normal(key, (256, 4096), jnp.float32)
+    labels = jax.random.randint(jax.random.fold_in(key, 9), (256,), 0, 4096)
+    labels = labels.at[::7].set(-1)
+
+    def xent(f):
+        def g(logits, labels):
+            def loss(logits):
+                return jnp.sum(f(logits, labels))
+            l, dl = jax.value_and_grad(loss)(logits)
+            return (l, dl)
+        return g
+
+    def xent_ref(logits, labels):
+        lse = jax.scipy.special.logsumexp(logits, -1)
+        nll = lse - jnp.take_along_axis(
+            logits, jnp.maximum(labels, 0)[:, None], 1)[:, 0]
+        return jnp.where(labels >= 0, nll, 0.0)
+
+    check("xentropy", 1e-3, xent(softmax_cross_entropy_loss),
+          xent(xent_ref), logits, labels)
+
+    # flat-buffer Pallas optimizer step vs the tree (pure-XLA) step with
+    # identical hyperparameters
+    nt = 32
+    keys2 = jax.random.split(key, nt)
+    params = {f"t{i}": jax.random.normal(kk, (64, 128)) for i, kk in
+              enumerate(keys2)}
+    grads = jax.tree.map(lambda p: p * 1e-3, params)
+    o_tree = FusedAdam(lr=1e-3, weight_decay=0.01)
+    o_flat = FusedAdam(lr=1e-3, weight_decay=0.01, use_flat_kernel=True)
+
+    def step3(opt):
+        st = opt.init(params)
+        def g(params, grads):
+            p, _ = opt.step(grads, params, st)
+            return p
+        return g
+
+    check("adam_flat_vs_tree", 1e-5, step3(o_flat), step3(o_tree),
+          params, grads)
+
+    failures = [n for n, (d, tol) in results.items() if d > tol]
+    emit("kernel_parity_compiled", 0.0 if failures else 1.0, "pass",
+         extra={"checks": len(results), "failures": failures,
+                "rel_diffs": {n: d for n, (d, _) in results.items()},
+                "compiled": bool(on_tpu)})
 
 
 CONFIGS = {
@@ -433,8 +775,31 @@ CONFIGS = {
     "ddp_bert": bench_ddp_bert,
     "tp_gpt": bench_tp_gpt,
     "flash_attention": bench_flash_attention,
+    "kernel_parity": bench_kernel_parity,
     "headline": bench_headline,
 }
+
+# Driver execution order (round-4 postmortem). The HEADLINE runs FIRST:
+# BENCH_r04 hit the driver's wall-clock cap (rc=124) with the contract
+# metric still unmeasured because it ran last. kernel_parity + flash run
+# next (cheap, and flash gets measured before any big-model config can
+# leave the relay/allocator in a damaged state — the leading theory for
+# r4's 27x seq2048 anomaly, which followed two GPT OOMs). The headline
+# line is RE-EMITTED at the very end so the driver's parse-the-tail
+# convention still lands on the contract metric.
+ORDER = ["headline", "kernel_parity", "flash_attention", "layer_norm",
+         "opt_adam", "opt_lamb", "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
+
+# Global wall budget (seconds) with per-config caps: the driver must see
+# a finished run. Generous-but-bounded; BENCH_BUDGET_S overrides. Cap
+# sizing (r5 shakeout, single-compile timer): XLA compiles through the
+# relay are the dominant cost and drift 2-3x between runs (the scan'd
+# Adam chunk compiled in 390/277/115 s on three consecutive tries), so
+# caps are ~2x the observed wall of each config.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2700"))
+CAP_S = {"headline": 600, "kernel_parity": 480, "ddp_bert": 540,
+         "tp_gpt": 600, "flash_attention": 540}
+DEFAULT_CAP_S = 480
 
 
 def main():
@@ -452,16 +817,45 @@ def main():
     # return freed pages promptly through the relay -- process isolation
     # guarantees each config starts with an empty HBM.
     import subprocess
-    for name in CONFIGS:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__), name],
-                           capture_output=True, text=True, timeout=1800)
+    deadline = time.time() + BUDGET_S
+    headline_line = None
+    for name in ORDER:
+        remaining = deadline - time.time()
+        if remaining < 45:
+            print(json.dumps({"metric": name,
+                              "skipped": "global budget exhausted"}),
+                  flush=True)
+            continue
+        cap = min(CAP_S.get(name, DEFAULT_CAP_S), remaining)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name],
+                capture_output=True, text=True, timeout=cap)
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or b""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    if '"bert_large_pretrain' in line:
+                        headline_line = line
+            print(json.dumps({"metric": name,
+                              "error": f"config cap {cap:.0f}s hit"}),
+                  flush=True)
+            continue
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 print(line, flush=True)
+                if '"bert_large_pretrain' in line \
+                        or '"bert_tiny_cpu_smoke' in line:
+                    headline_line = line
         if r.returncode != 0 and not any(
                 ln.startswith("{") for ln in r.stdout.splitlines()):
             print(json.dumps({"metric": name,
                               "error": (r.stderr or "")[-200:]}), flush=True)
+    if headline_line:  # the tail-parsed line must be the contract metric
+        print(headline_line, flush=True)
 
 
 if __name__ == "__main__":
