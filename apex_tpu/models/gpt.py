@@ -62,6 +62,15 @@ class GPTConfig:
     # plus recompute — mandatory at gpt_medium scale on one chip (ref
     # analogue: Megatron's --recompute-granularity)
     remat: bool = False
+    # optional jax.checkpoint policy name (an attribute of
+    # jax.checkpoint_policies, e.g. "dots_saveable"): the analogue of
+    # Megatron's --recompute-granularity=selective — matmul outputs are
+    # SAVED and only the cheap elementwise chain (LN, gelu, residuals)
+    # is recomputed in backward. Middle ground between full remat's
+    # ~33% fwd recompute and no-remat's O(layers · per-op) live set
+    # (whose single-chip gpt_medium program is too large for the
+    # compile helper at b>=8, measured r5).
+    remat_policy: Optional[str] = None
     # Megatron sequence parallelism: activations OUTSIDE the TP regions
     # (LN, residuals, dropout) are sharded along seq over the model axis
     # (seq_dim=1 in this model's (b, s, h) layout); Column gathers /
@@ -295,7 +304,9 @@ def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
                       dropout_rng=rng, ring=ring)
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        pol = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+               if cfg.remat_policy else None)
+        block = jax.checkpoint(block, policy=pol)
     if dropout_rng is None:
         x, _ = lax.scan(lambda x, lp: (block(lp, x, None), None),
                         x, layers)
@@ -678,10 +689,12 @@ def gpt_pipeline_model(model: GPTModel) -> "PipelineModel":
 def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
                  batch: Optional[int] = None, remat: bool = False
                  ) -> Tuple[Any, Any, Any, int]:
-    """Returns (body, init_state, fetch, global_batch) for bench.py:
+    """Returns (body, make_init, fetch, global_batch) for bench.py:
     a full TP train step (loss, grads inside shard_map; FusedAdam update)
-    on a tp=n mesh. ``batch``/``remat`` let bench.py sweep configs the
-    way the BERT headline does."""
+    on a tp=n mesh. ``make_init`` is a zero-arg factory building the
+    (params, opt_state) train state on device, so bench.py's donating
+    timer keeps exactly ONE copy in HBM. ``batch``/``remat`` let
+    bench.py sweep configs the way the BERT headline does."""
     import dataclasses
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -689,8 +702,15 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
     from apex_tpu.optimizers import FusedAdam
 
     cfg = gpt_medium() if on_tpu else gpt_tiny()
-    if remat:
-        cfg = dataclasses.replace(cfg, remat=True)
+    # gpt_medium() defaults remat=True — OVERRIDE both ways, or every
+    # "remat=False" bench config silently pays the ~33% fwd recompute
+    # (which is exactly what flattened gpt_tp1_step at ~30 samples/s
+    # through rounds 3-4). A string names a jax.checkpoint policy
+    # (selective recompute).
+    if isinstance(remat, str):
+        cfg = dataclasses.replace(cfg, remat=True, remat_policy=remat)
+    else:
+        cfg = dataclasses.replace(cfg, remat=bool(remat))
     default_b, seq = (8, 1024) if on_tpu else (2, 32)
     batch = default_b if batch is None else batch
     ids = jnp.zeros((batch, seq), jnp.int32)
@@ -700,9 +720,12 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
         # path so the step compiles without topology metadata (the axon
         # relay's chipless AOT helper cannot resolve host bounds for
         # mesh-collective programs; the CPU rig covers the collectives)
-        params = init_gpt(jax.random.PRNGKey(0), cfg)
         opt = FusedAdam(lr=1e-4, weight_decay=0.01)
-        opt_state = opt.init(params)
+
+        def make_init():
+            params = init_gpt(jax.random.PRNGKey(0), cfg)
+            return params, opt.init(params)
+
         # bf16 compute over fp32 params (O2-style: optimizer math fp32):
         # measured 30.0 vs 23.5 samples/s over fp32 compute on v5e
         vg = jax.value_and_grad(
@@ -714,21 +737,23 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
             _, grads = vg(p)
             return opt.step(grads, p, o)
 
-        return (body1, (params, opt_state),
+        return (body1, make_init,
                 lambda s: jnp.sum(s[0]["final_ln"]["weight"]), batch)
     ps.destroy_model_parallel()
     mesh = ps.initialize_model_parallel(
         tensor_model_parallel_size_=n_devices)
     model = GPTModel(cfg, tp_size=n_devices)
-    params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4, weight_decay=0.01)
-    opt_state = opt.init(params)
     specs = model.partition_specs()
     shard = lambda tree, sp: jax.tree.map(  # noqa: E731
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, sp)
-    params = shard(params, specs)
-    opt_state = opt_state._replace(m=shard(opt_state.m, specs),
-                                   v=shard(opt_state.v, specs))
+
+    def make_init():
+        # opt.init's zeros_like inherits the params' NamedSharding, so
+        # m/v come out sharded without a second device_put pass
+        params = shard(model.init(jax.random.PRNGKey(0)), specs)
+        return params, opt.init(params)
+
     ids = jnp.zeros((batch, seq), jnp.int32)
     labels = jnp.zeros((batch, seq), jnp.int32)
 
@@ -749,4 +774,4 @@ def gpt_tp_bench(on_tpu: bool, n_devices: int, *,
     def fetch(state):
         return jnp.sum(state[0]["final_ln"]["weight"])
 
-    return body, (params, opt_state), fetch, batch
+    return body, make_init, fetch, batch
