@@ -40,10 +40,16 @@ from apex_tpu.amp.scaler import (
 
 
 class Amp:
-    """Bundle of an opt-level's Properties + a LossScaler + cast helpers."""
+    """Bundle of an opt-level's Properties + a LossScaler + cast helpers.
 
-    def __init__(self, properties: Properties):
+    ``num_losses`` mirrors the reference's ``amp.initialize(...,
+    num_losses=N)``: ``init_state`` then returns a TUPLE of independent
+    scaler states, and the reference's ``loss_id`` argument becomes
+    plain indexing (``h.scale_loss(loss, state[i])``)."""
+
+    def __init__(self, properties: Properties, num_losses: int = 1):
         self.properties = properties
+        self.num_losses = int(num_losses)
         self.scaler = LossScaler(loss_scale=properties.loss_scale)
 
     # -- model / input casting -----------------------------------------
@@ -76,8 +82,11 @@ class Amp:
         return autocast(dtype=dtype, enabled=bool(p.patch_torch_functions))
 
     # -- scaler ---------------------------------------------------------
-    def init_state(self) -> LossScalerState:
-        return self.scaler.init_state()
+    def init_state(self):
+        if self.num_losses == 1:
+            return self.scaler.init_state()
+        return tuple(self.scaler.init_state()
+                     for _ in range(self.num_losses))
 
     def scale_loss(self, loss, state: LossScalerState):
         return self.scaler.scale(loss, state)
@@ -118,11 +127,24 @@ class Amp:
         return wrapped
 
     # -- checkpointing (ref: ``amp.state_dict``) ------------------------
-    def state_dict(self, state: LossScalerState) -> dict:
-        return {"loss_scaler0": self.scaler.state_dict(state)}
+    def state_dict(self, state) -> dict:
+        """N-scaler form of the reference's ``amp.state_dict``: one
+        ``loss_scalerI`` entry per state (a single state is scaler 0)."""
+        states = state if isinstance(state, (list, tuple)) else (state,)
+        return {f"loss_scaler{i}": self.scaler.state_dict(s)
+                for i, s in enumerate(states)}
 
-    def load_state_dict(self, d: dict) -> LossScalerState:
-        return self.scaler.load_state_dict(d["loss_scaler0"])
+    def load_state_dict(self, d: dict):
+        keys = sorted((k for k in d if k.startswith("loss_scaler")
+                       and k[len("loss_scaler"):].isdigit()),
+                      key=lambda k: int(k[len("loss_scaler"):]))
+        if len(keys) != self.num_losses:
+            raise ValueError(
+                f"amp state_dict has {len(keys)} loss_scaler entries but "
+                f"this handle was initialized with num_losses="
+                f"{self.num_losses}")
+        states = tuple(self.scaler.load_state_dict(d[k]) for k in keys)
+        return states[0] if self.num_losses == 1 else states
 
 
 def initialize(
@@ -134,6 +156,7 @@ def initialize(
     loss_scale=None,
     enabled: bool = True,
     verbosity: int = 1,
+    num_losses: int = 1,
 ) -> Amp:
     """Build an :class:`Amp` handle from an opt-level + overrides.
 
@@ -170,4 +193,4 @@ def initialize(
         logging.getLogger("apex_tpu").info(
             "amp.initialize: opt_level=%s properties=%s", opt_level, props
         )
-    return Amp(props)
+    return Amp(props, num_losses=num_losses)

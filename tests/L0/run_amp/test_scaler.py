@@ -84,3 +84,29 @@ def test_state_dict_roundtrip():
     s2 = scaler.load_state_dict(d)
     assert float(s2.loss_scale) == float(s.loss_scale)
     assert int(s2.unskipped) == int(s.unskipped)
+
+
+def test_amp_multi_loss_state_dict_roundtrip():
+    """Reference parity: ``amp.initialize(num_losses=N)`` keeps N
+    independent scalers and ``amp.state_dict`` carries all of them
+    (``loss_scaler0..N-1``), not just scaler 0."""
+    h = amp.initialize("O2", loss_scale="dynamic", num_losses=3,
+                       verbosity=0)
+    states = h.init_state()
+    assert isinstance(states, tuple) and len(states) == 3
+    # overflow only loss 1: its scale halves, the others stay put
+    states = (states[0],
+              h.update_scale(states[1], jnp.asarray(True)),
+              states[2])
+    d = h.state_dict(states)
+    assert set(d) == {"loss_scaler0", "loss_scaler1", "loss_scaler2"}
+    back = h.load_state_dict(d)
+    assert float(back[1].loss_scale) == 2.0 ** 15
+    assert float(back[0].loss_scale) == 2.0 ** 16
+
+    # single-loss handles keep the flat shape both ways
+    h1 = amp.initialize("O2", loss_scale="dynamic", verbosity=0)
+    s = h1.init_state()
+    assert not isinstance(s, tuple)
+    assert set(h1.state_dict(s)) == {"loss_scaler0"}
+    assert not isinstance(h1.load_state_dict(h1.state_dict(s)), tuple)
