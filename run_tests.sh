@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# One-command test runner (reference: ``tests/L0/run_test.py`` — the
+# upstream entry point CI and contributors invoke). Tiers:
+#
+#   ./run_tests.sh          # L0: unit/integration suite (CPU, 8 virtual
+#                           #     devices via tests/conftest.py)
+#   ./run_tests.sh L1       # L1: loss-curve parity sweeps (slower)
+#   ./run_tests.sh all      # both
+#
+# The suite forces the CPU backend inside conftest.py (the axon env pins
+# JAX_PLATFORMS at interpreter start, so pytest must be run through this
+# entry or plain `python -m pytest` — never with JAX_PLATFORMS exported).
+set -euo pipefail
+cd "$(dirname "$0")"
+tier="${1:-L0}"
+shift || true
+case "$tier" in
+  L0)  exec python -m pytest tests/L0 -q "$@" ;;
+  L1)  exec python -m pytest tests/L1 -q "$@" ;;
+  all) exec python -m pytest tests -q "$@" ;;
+  *)   echo "usage: $0 [L0|L1|all] [pytest args...]" >&2; exit 2 ;;
+esac

@@ -228,6 +228,6 @@ def test_dropout_active_and_deterministic():
 def test_bench_hook_smoke():
     from apex_tpu.models.gpt import gpt_tp_bench
 
-    body, state, fetch, batch = gpt_tp_bench(False, 8)
-    state = body(state)
+    body, make_init, fetch, batch = gpt_tp_bench(False, 8)
+    state = body(make_init())
     assert np.isfinite(float(fetch(state)))

@@ -1,0 +1,106 @@
+"""L1 cross-product tier on the imagenet/ResNet path (SURVEY §4 — the
+reference's ``tests/L1/cross_product/run.sh`` sweeps opt-level x
+keep_batchnorm_fp32 x loss-scale over the imagenet example and compares
+loss curves). BatchNorm is the point: ``keep_batchnorm_fp32`` only bites
+on a model that HAS batch norm, which the BERT/GPT L1 sweeps don't.
+
+Golden curve = the package's own O0 (fp32) run on identical data; every
+swept combination must track it step by step and converge on its own.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.models import apply_resnet, cross_entropy_loss, init_resnet
+from apex_tpu.optimizers import FusedSGD
+
+STEPS = 8
+DEPTH = 10
+CLASSES = 10
+BATCH, IMG = 8, 32
+
+
+def resnet_curve(opt_level, kbn=None, loss_scale="dynamic", seed=0):
+    """Loss curve of the imagenet example's train step (amp cast ->
+    value_and_grad -> FusedSGD with found_inf gating -> bn-stats skip)."""
+    h = amp.initialize(opt_level=opt_level, keep_batchnorm_fp32=kbn,
+                       loss_scale=loss_scale, verbosity=0)
+    params, bn_stats = init_resnet(jax.random.PRNGKey(seed), DEPTH, CLASSES)
+    # lr low enough that the toy model does NOT memorize the data in one
+    # step — the curve must stay O(1) for a per-step relative comparison
+    # to mean anything
+    opt = FusedSGD(lr=0.01, momentum=0.9, weight_decay=1e-4)
+    opt_state = opt.init(params)
+    scaler_state = h.init_state()
+
+    @jax.jit
+    def step(master, bn_stats, opt_state, scaler_state, images, labels):
+        p = h.cast_model(master)
+        images = h.cast_input(images)
+
+        def loss_fn(p):
+            logits, new_stats = apply_resnet(p, bn_stats, images, DEPTH,
+                                             train=True)
+            return cross_entropy_loss(logits, labels), new_stats
+
+        (loss, new_stats), grads, found_inf, scaler_state = \
+            h.value_and_grad(loss_fn, has_aux=True)(p, scaler_state)
+        master, opt_state = opt.step(grads, master, opt_state,
+                                     found_inf=found_inf)
+        new_stats = amp.apply_if_finite(new_stats, bn_stats, found_inf)
+        return master, new_stats, opt_state, scaler_state, loss
+
+    losses = []
+    # one FIXED batch (overfit) so the convergence check is unambiguous;
+    # lr is low enough that memorization takes the whole curve instead
+    # of collapsing to ~1e-2 in one step (where relative comparison is
+    # meaningless)
+    k = jax.random.PRNGKey(7_000)
+    images = jax.random.normal(k, (BATCH, IMG, IMG, 3), jnp.float32)
+    labels = jax.random.randint(k, (BATCH,), 0, CLASSES)
+    for i in range(STEPS):
+        params, bn_stats, opt_state, scaler_state, loss = step(
+            params, bn_stats, opt_state, scaler_state, images, labels)
+        losses.append(float(loss))
+    return np.array(losses)
+
+
+@pytest.fixture(scope="module")
+def golden_curve():
+    return resnet_curve("O0", loss_scale=1.0)
+
+
+def test_golden_resnet_converges(golden_curve):
+    assert np.all(np.isfinite(golden_curve))
+    assert golden_curve[-1] < golden_curve[0] - 0.1, golden_curve
+
+
+# The reference's run.sh crosses every axis; the informative subset is
+# each opt level with both keep_batchnorm settings and both loss-scale
+# modes represented (kbn is meaningless at O0/O1, where the model is
+# not cast — SURVEY §4).
+@pytest.mark.parametrize("opt_level,kbn,loss_scale", [
+    ("O1", None, "dynamic"),
+    ("O2", True, "dynamic"),
+    ("O2", False, 128.0),
+    ("O3", True, 128.0),
+    ("O3", False, "dynamic"),
+])
+def test_resnet_amp_curve_tracks_fp32(golden_curve, opt_level, kbn,
+                                      loss_scale):
+    curve = resnet_curve(opt_level, kbn=kbn, loss_scale=loss_scale)
+    assert np.all(np.isfinite(curve))
+    # BatchNorm feeds bf16 rounding back through its running statistics,
+    # so cast-model curves wander more than the LN-only BERT/GPT sweeps
+    # (measured ~7% worst-step at O2) — tolerances reflect that; O3
+    # without fp32 batchnorm is the loosest recipe the reference ships
+    rtol = 0.15 if opt_level == "O3" else 0.10
+    # atol floors the comparison once the toy model has memorized the
+    # batch (loss ~1e-2..1e-3, where bf16 step noise swamps rtol)
+    np.testing.assert_allclose(curve, golden_curve, rtol=rtol, atol=0.02)
+    assert curve[-1] < curve[0] - 0.1
+    if opt_level != "O1":  # O1 touches only opted-in ops on this model
+        assert np.any(curve != golden_curve)
