@@ -19,7 +19,11 @@ ARGS = ["-a", "resnet10", "--image-size", "32", "--num-classes", "10",
 
 
 def run(args, env_extra=None):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # JAX_PLATFORMS in the env is LATCHED AWAY by sitecustomize on this
+    # host (the subprocesses were silently running on the real TPU
+    # through the relay — 157 s of suite wall); APEX_TPU_TEST_PLATFORM
+    # goes through jax.config inside the example instead.
+    env = dict(os.environ, APEX_TPU_TEST_PLATFORM="cpu")
     env.update(env_extra or {})
     r = subprocess.run([sys.executable, SCRIPT] + ARGS + args,
                        capture_output=True, text=True, timeout=1200,

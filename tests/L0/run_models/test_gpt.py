@@ -228,6 +228,8 @@ def test_dropout_active_and_deterministic():
 def test_bench_hook_smoke():
     from apex_tpu.models.gpt import gpt_tp_bench
 
-    body, make_init, fetch, batch = gpt_tp_bench(False, 8)
+    # tp=2 keeps the hook-contract check ~4x cheaper than tp=8 on the
+    # 1-core host; the tp=8 math itself is covered by the tp8 tests
+    body, make_init, fetch, batch = gpt_tp_bench(False, 2)
     state = body(make_init())
     assert np.isfinite(float(fetch(state)))

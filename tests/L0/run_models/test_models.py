@@ -122,13 +122,20 @@ def test_resnet18_forward_and_step():
     params, stats = init_resnet(jax.random.PRNGKey(0), 18, num_classes=10)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (2,), 0, 10)
-    logits, new_stats = apply_resnet(params, stats, x, 18, train=True)
+    # jitted: eager per-op dispatch of the whole stack costs ~11 s on
+    # the 1-core host; the compiled program lands in the persistent
+    # test cache
+    logits, new_stats = jax.jit(
+        lambda p, s, x: apply_resnet(p, s, x, 18, train=True))(
+        params, stats, x)
     assert logits.shape == (2, 10)
     # running stats updated
     assert not np.allclose(np.asarray(new_stats["stem_bn"]["mean"]),
                            np.asarray(stats["stem_bn"]["mean"]))
     # eval mode leaves stats untouched
-    _, same = apply_resnet(params, stats, x, 18, train=False)
+    _, same = jax.jit(
+        lambda p, s, x: apply_resnet(p, s, x, 18, train=False))(
+        params, stats, x)
     np.testing.assert_array_equal(np.asarray(same["stem_bn"]["mean"]),
                                   np.asarray(stats["stem_bn"]["mean"]))
 
@@ -154,5 +161,7 @@ def test_resnet18_forward_and_step():
 def test_resnet50_builds():
     params, stats = init_resnet(jax.random.PRNGKey(0), 50, num_classes=10)
     x = jnp.ones((1, 64, 64, 3))
-    logits, _ = apply_resnet(params, stats, x, 50, train=False)
+    logits, _ = jax.jit(
+        lambda p, s, x: apply_resnet(p, s, x, 50, train=False))(
+        params, stats, x)
     assert logits.shape == (1, 10)
