@@ -90,7 +90,7 @@ def emit(metric, value, unit, extra=None, higher_is_better=True):
     print(json.dumps(rec), flush=True)
 
 
-def timed(body, init_state, fetch, M, K=4, donate=False):
+def timed(body, init_state, fetch, M, K=4, donate=False, chain=True):
     """Median seconds per iteration of ``body`` (state -> state, a pytree
     step function), measured by DIFFERENCING two scan-chunk lengths.
 
@@ -114,15 +114,46 @@ def timed(body, init_state, fetch, M, K=4, donate=False):
     BENCH_r04's b16 GPT configs into spurious ResourceExhausted. Timing
     is value-independent on TPU, so an evolving state measures the same
     program the replay did."""
-    def chunk_body(state):
-        def f(s, _):
-            return body(s), ()
-        s, _ = jax.lax.scan(f, state, None, length=M)
-        return s
+    def chunk_fn(length):
+        def chunk_body(state):
+            def f(s, _):
+                return body(s), ()
+            s, _ = jax.lax.scan(f, state, None, length=length)
+            return s
+        return jax.jit(chunk_body, donate_argnums=0) if donate \
+            else jax.jit(chunk_body)
 
-    chunk = jax.jit(chunk_body, donate_argnums=0) if donate \
-        else jax.jit(chunk_body)
+    chunk = chunk_fn(M)
     box = [init_state() if donate else init_state]
+
+    # chain=False: the two-PROGRAM differencing ancestor — scan(M) and
+    # scan(5M) each dispatched once, (t2-t1)/4M. Needed when the state
+    # is a MANY-LEAF pytree: a chained dispatch pays host-side pytree
+    # flattening per call (~38 ms for the 1024-small-tensor Adam state),
+    # and the chain scheme puts 4 extra dispatches inside the measured
+    # delta — dispatch/M lands in the per-iter number (measured: the
+    # tree-path small-tensor metric read 2.75 ms vs its true ~0.9 ms).
+    # Two programs pay double compile, so chain=False is only for
+    # benches whose chunk compiles fast.
+    if not chain:
+        c2 = chunk_fn(5 * M)
+
+        def t_of2(c):
+            state = c(box[0])
+            float(fetch(state))
+            if donate:
+                box[0] = state
+            ts = []
+            for _ in range(K):
+                t0 = time.perf_counter()
+                state = c(box[0])
+                float(fetch(state))
+                ts.append(time.perf_counter() - t0)
+                if donate:
+                    box[0] = state
+            return statistics.median(ts)
+
+        return max(t_of2(c2) - t_of2(chunk), 1e-9) / (4 * M)
 
     # ONE compiled program: the long chunk is 5 CHAINED dispatches of the
     # same jitted scan, not a separately-compiled 5M-scan. jit dispatch
@@ -156,7 +187,7 @@ def timed(body, init_state, fetch, M, K=4, donate=False):
 
 
 def checked(metric, unit_scale, body, init_state, fetch, M, K=4,
-            donate=False):
+            donate=False, chain=True):
     """``timed`` plus a sanity gate against the metric's own driver
     history: if the fresh measurement lands >3x off the last
     driver-recorded value, measure ONCE more and keep the faster run.
@@ -164,7 +195,7 @@ def checked(metric, unit_scale, body, init_state, fetch, M, K=4,
     read 27x slow while seq4096 in the same process was healthy), so
     min() is the honest pick. Returns (dt_seconds, extra) where extra
     carries the retry provenance for the emitted line."""
-    dt = timed(body, init_state, fetch, M, K, donate=donate)
+    dt = timed(body, init_state, fetch, M, K, donate=donate, chain=chain)
     extra = {}
     prior = [v for v in _recorded_values(metric) if v]
     if prior:
@@ -176,7 +207,7 @@ def checked(metric, unit_scale, body, init_state, fetch, M, K=4,
         if ratio > 3.0 or ratio < 1.0 / 3.0:
             first = dt
             dt = min(dt, timed(body, init_state, fetch, M, K,
-                               donate=donate))
+                               donate=donate, chain=chain))
             extra = {"retried": True,
                      "first": round(first * unit_scale, 2),
                      "suspect": dt * unit_scale / best > 3.0}
@@ -295,9 +326,12 @@ def bench_flat_vs_tree_many_tensors(on_tpu):
             return opt.step(grads, p, s)
 
         metric = f"fused_adam_{name}_{n}_small_tensors"
+        # chain=False: a 1024-leaf state pays ~38 ms of host pytree
+        # flattening per dispatch — the chain scheme's 4 extra
+        # dispatches would land dispatch/M in the metric (see timed)
         dt, extra = checked(metric, 1e3, body, (params, opt_state),
                             lambda s: jnp.sum(s[0]["t0"]),
-                            M=20 if on_tpu else 2)
+                            M=20 if on_tpu else 2, chain=False)
         emit(metric, dt * 1e3, "ms/step", extra=extra,
              higher_is_better=False)
 
