@@ -126,6 +126,39 @@ def timed(body, init_state, fetch, M, K=4, donate=False, chain=True):
     chunk = chunk_fn(M)
     box = [init_state() if donate else init_state]
 
+    def run(c, ncalls=1):
+        """ncalls dispatches of program ``c`` (async, back-to-back on
+        device), box-threaded under donation, one fetch at the end."""
+        state = c(box[0])
+        for _ in range(ncalls - 1):
+            state = c(state)
+        if donate:
+            box[0] = state
+        float(fetch(state))
+
+    def t_of(c, ncalls=1):
+        run(c, ncalls)  # compile + warm
+        ts = []
+        for _ in range(K):
+            t0 = time.perf_counter()
+            run(c, ncalls)
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    if chain:
+        # ONE compiled program: the long measurement is 5 CHAINED
+        # dispatches of the same jitted scan, not a separately-compiled
+        # 5M-scan. jit dispatch is async, so the chain runs back-to-back
+        # on device and the fetch syncs once at the end;
+        # (t(5 calls) - t(1 call)) / 4M cancels the relay's fixed
+        # dispatch+fetch cost exactly like the two-program scheme —
+        # validated on the Adam bench (12.56 ms vs the two-program
+        # 11.9-12.6 ms band) — while paying ONE XLA compile. That
+        # matters: the scan-of-50 FusedAdam chunk alone took ~390 s to
+        # compile through the relay, which is what pushed opt_adam past
+        # its config cap in the r5 shakeout run.
+        return max(t_of(chunk, 5) - t_of(chunk, 1), 1e-9) / (4 * M)
+
     # chain=False: the two-PROGRAM differencing ancestor — scan(M) and
     # scan(5M) each dispatched once, (t2-t1)/4M. Needed when the state
     # is a MANY-LEAF pytree: a chained dispatch pays host-side pytree
@@ -135,55 +168,8 @@ def timed(body, init_state, fetch, M, K=4, donate=False, chain=True):
     # tree-path small-tensor metric read 2.75 ms vs its true ~0.9 ms).
     # Two programs pay double compile, so chain=False is only for
     # benches whose chunk compiles fast.
-    if not chain:
-        c2 = chunk_fn(5 * M)
-
-        def t_of2(c):
-            state = c(box[0])
-            float(fetch(state))
-            if donate:
-                box[0] = state
-            ts = []
-            for _ in range(K):
-                t0 = time.perf_counter()
-                state = c(box[0])
-                float(fetch(state))
-                ts.append(time.perf_counter() - t0)
-                if donate:
-                    box[0] = state
-            return statistics.median(ts)
-
-        return max(t_of2(c2) - t_of2(chunk), 1e-9) / (4 * M)
-
-    # ONE compiled program: the long chunk is 5 CHAINED dispatches of the
-    # same jitted scan, not a separately-compiled 5M-scan. jit dispatch
-    # is async, so the chain runs back-to-back on device and the fetch
-    # syncs once at the end; (t(5 calls) - t(1 call)) / 4M cancels the
-    # relay's fixed dispatch+fetch cost exactly like the two-program
-    # scheme did — validated on the Adam bench (12.56 ms vs the
-    # two-program 11.9-12.6 ms band) — while paying ONE XLA compile.
-    # That matters: the scan-of-50 FusedAdam chunk alone took ~390 s to
-    # compile through the relay, which is what pushed opt_adam past its
-    # config cap in the r5 shakeout run.
-    def run(ncalls):
-        state = chunk(box[0])
-        for _ in range(ncalls - 1):
-            state = chunk(state)
-        if donate:
-            box[0] = state
-        float(fetch(state))
-
-    run(1)  # compile + warm
-
-    def t_of(ncalls):
-        ts = []
-        for _ in range(K):
-            t0 = time.perf_counter()
-            run(ncalls)
-            ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
-
-    return max(t_of(5) - t_of(1), 1e-9) / (4 * M)
+    c2 = chunk_fn(5 * M)
+    return max(t_of(c2) - t_of(chunk), 1e-9) / (4 * M)
 
 
 def checked(metric, unit_scale, body, init_state, fetch, M, K=4,

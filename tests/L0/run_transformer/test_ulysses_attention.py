@@ -63,6 +63,8 @@ def test_padding_mask():
 
 
 def test_grads_match():
+    # jit'd: grad-of-shard_map traced eagerly cost ~23 s on the 1-core
+    # host; forward-parity tests keep the eager path covered
     q, k, v = data(2)
 
     def loss(fn):
@@ -70,10 +72,11 @@ def test_grads_match():
             return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
         return inner
 
-    got = jax.grad(loss(lambda q, k, v: run_ulysses(q, k, v, causal=True)),
-                   argnums=(0, 1, 2))(q, k, v)
-    want = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    got = jax.jit(jax.grad(
+        loss(lambda q, k, v: run_ulysses(q, k, v, causal=True)),
+        argnums=(0, 1, 2)))(q, k, v)
+    want = jax.jit(jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True)), argnums=(0, 1, 2)))(q, k, v)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=2e-4, atol=2e-4)
