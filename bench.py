@@ -1,24 +1,28 @@
 #!/usr/bin/env python
 """BASELINE benchmark suite (BASELINE.md / BASELINE.json).
 
-Prints one JSON line per config, the NORTH-STAR metric LAST (the driver
-records the tail of stdout):
-
-  1. FusedLayerNorm fwd+bwd microbench, hidden 1024 / 4096
-  2. FusedAdam / FusedLAMB optimizer step on the BERT-Large param set
-  3. DDP BERT-Large train step over all local devices (dp = n_devices)
-  4. Tensor-parallel GPT train step (tp = n_devices)
-  5. BERT-Large pretrain step, amp O2 + FusedAdam + FusedLayerNorm
-     (samples/sec/chip — the headline)
+Prints one JSON line per config. The NORTH-STAR headline (BERT-Large
+pretrain, amp O2 + FusedAdam, samples/sec/chip) runs FIRST — so a
+budget/timeout death can't lose the contract metric — and its line is
+RE-EMITTED LAST so the driver's parse-the-tail convention lands on it.
+Execution order (see ``ORDER``): headline, compiled-kernel parity,
+flash attention (d=64 seq 2048/4096 + the d=128 MXU-full line),
+LN/RMS microbench, FusedAdam / FusedLAMB on the BERT-Large param set,
+the flat-vs-tree 1024-small-tensor pair, DDP BERT, TP GPT. A global
+wall budget (``BENCH_BUDGET_S``, default 45 min) with per-config caps
+guarantees the run finishes; skipped/capped configs emit marker lines.
 
 Timing methodology (see axon-relay pitfall): ``jax.block_until_ready``
-does not reliably synchronize through the relay, so every measured chunk
-ends in a ``float()`` fetch of a value data-dependent on the whole chunk;
-chunks of M chained steps amortize the fetch round-trip; the reported
-number is the median over K chunks. ``vs_baseline`` compares against the
-matching metric in the latest driver-written ``BENCH_r*.json`` (nested
-under ``"parsed"``) when present, else null (the reference publishes no
-numbers — BASELINE.md).
+does not reliably synchronize through the relay, so every measurement
+ends in a ``float()`` fetch of a value data-dependent on the whole
+chain, and per-iteration time is the DIFFERENCE of two measured chain
+lengths (fixed dispatch+fetch cost cancels) — see ``timed`` for the
+single-program chained scheme, the two-program many-leaf scheme, and
+the donating state protocol. ``vs_baseline`` compares against the
+latest driver-written ``BENCH_r*.json`` round, ``vs_best`` against the
+best round ever (the reference publishes no numbers — BASELINE.md);
+``checked`` re-measures once when a result lands >3x off its best
+recorded value.
 """
 
 import functools
