@@ -7,8 +7,30 @@ automatically via :func:`pallas_interpret`".
 """
 
 import functools
+import os
 
 import jax
+
+
+def apply_test_platform_override() -> bool:
+    """Honor ``APEX_TPU_TEST_PLATFORM`` via ``jax.config`` — the ONLY
+    mechanism that works on hosts whose sitecustomize imports jax at
+    interpreter startup (plain ``JAX_PLATFORMS`` in the env is latched
+    away before it can apply, including for subprocesses). Must be
+    called BEFORE any device use. For ``cpu``,
+    ``APEX_TPU_TEST_NUM_DEVICES`` (default 8, the test rig's mesh
+    width) sizes the virtual device world. Returns True when an
+    override was applied. Entry points that tests drive as
+    subprocesses (bench.py, examples) call this at import time."""
+    plat = os.environ.get("APEX_TPU_TEST_PLATFORM")
+    if not plat:
+        return False
+    jax.config.update("jax_platforms", plat)
+    if plat == "cpu":
+        jax.config.update(
+            "jax_num_cpu_devices",
+            int(os.environ.get("APEX_TPU_TEST_NUM_DEVICES", "8")))
+    return True
 
 
 @functools.cache

@@ -36,6 +36,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
+
+# Test-rig platform override BEFORE any device use; driver runs leave
+# the env unset and land on the attached TPU.
+from apex_tpu.utils.platform import apply_test_platform_override
+apply_test_platform_override()
+
 import jax.numpy as jnp
 
 BERT_LARGE_PARAMS = 336e6  # ≈ param count incl. embeddings
@@ -77,9 +83,13 @@ def emit(metric, value, unit, extra=None, higher_is_better=True):
     """vs_baseline compares to the LATEST recorded round; vs_best to the
     best round EVER, so a regression-after-a-regression can't report >1
     (round-3 verdict weak #8). Both >1 = this run is better."""
-    # drop zeros: a recorded 0 (failed round, or rounded-to-0.0 tiny
-    # value) would be a zero denominator in the ratios below
-    prior = [v for v in _recorded_values(metric) if v]
+    # drop zeros (a recorded 0 would be a zero denominator below) and
+    # skip history entirely off-TPU: recorded values are TPU-scale, and
+    # CPU smoke runs share metric names at tiny shapes — the ratios
+    # would be bogus
+    from apex_tpu.utils.platform import has_tpu
+    prior = [v for v in _recorded_values(metric) if v] if has_tpu() \
+        else []
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
            "vs_baseline": None}
     if prior:
@@ -187,7 +197,11 @@ def checked(metric, unit_scale, body, init_state, fetch, M, K=4,
     carries the retry provenance for the emitted line."""
     dt = timed(body, init_state, fetch, M, K, donate=donate, chain=chain)
     extra = {}
-    prior = [v for v in _recorded_values(metric) if v]
+    from apex_tpu.utils.platform import has_tpu
+    # the recorded history is TPU-scale; gating CPU smoke runs against
+    # it would force a meaningless retry of every metric
+    prior = [v for v in _recorded_values(metric) if v] if has_tpu() \
+        else []
     if prior:
         # gate against the BEST prior round: a damaged recorded value
         # (r4's 94.99 ms flash seq2048) must not poison the gate the
@@ -843,7 +857,18 @@ def main():
     import subprocess
     deadline = time.time() + BUDGET_S
     headline_line = None
+    # BENCH_ONLY="headline,layer_norm" filters the run (test rig /
+    # targeted re-measures); order is still ORDER's.
+    only = [s.strip() for s in os.environ.get("BENCH_ONLY", "").split(",")
+            if s.strip()]
+    for name in only:
+        if name not in CONFIGS:
+            print(json.dumps({"metric": name,
+                              "error": "unknown BENCH_ONLY config"}),
+                  flush=True)
     for name in ORDER:
+        if only and name not in only:
+            continue
         remaining = deadline - time.time()
         if remaining < 45:
             print(json.dumps({"metric": name,
@@ -862,7 +887,8 @@ def main():
             for line in out.splitlines():
                 if line.startswith("{"):
                     print(line, flush=True)
-                    if '"bert_large_pretrain' in line:
+                    if '"bert_large_pretrain' in line \
+                            or '"bert_tiny_cpu_smoke' in line:
                         headline_line = line
             print(json.dumps({"metric": name,
                               "error": f"config cap {cap:.0f}s hit"}),

@@ -15,23 +15,19 @@ Run: python examples/imagenet/main_amp.py --steps 30 -b 64 --opt-level O2
 
 import argparse
 import functools
-import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-# Honor a platform override BEFORE any device use. Plain JAX_PLATFORMS
-# does NOT work on hosts whose sitecustomize imports jax at interpreter
-# startup (the env var is latched before it can be set); jax.config
-# still works until the first backend touch. The test rig uses this to
-# keep example subprocesses off the real TPU.
-_plat = os.environ.get("APEX_TPU_TEST_PLATFORM")
-if _plat:
-    jax.config.update("jax_platforms", _plat)
-
 sys.path.insert(0, __file__.rsplit("/examples/", 1)[0])
+
+# Honor the test rig's platform override BEFORE any device use (plain
+# JAX_PLATFORMS is latched away by sitecustomize on this class of host;
+# see apply_test_platform_override).
+from apex_tpu.utils.platform import apply_test_platform_override  # noqa: E402
+apply_test_platform_override()
 
 from apex_tpu import amp  # noqa: E402
 from apex_tpu.models import apply_resnet, cross_entropy_loss, init_resnet  # noqa: E402

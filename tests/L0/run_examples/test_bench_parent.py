@@ -1,0 +1,37 @@
+"""Driver-contract smoke for bench.py's PARENT mode — the orchestration
+layer (config ORDER, per-config subprocesses, budget handling, headline
+re-emission) that otherwise only runs on the live TPU at round end.
+BENCH_r04's rc=124 was an orchestration failure, not a kernel failure;
+this pins the wiring on the CPU rig."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+def test_parent_runs_headline_first_and_reemits_it_last():
+    env = dict(os.environ,
+               APEX_TPU_TEST_PLATFORM="cpu",   # JAX_PLATFORMS is latched
+               BENCH_ONLY="headline,layer_norm",
+               BENCH_BUDGET_S="300")
+    # test timeout exceeds the parent's budget + caps so a hung child
+    # surfaces as the parent's own cap/skip lines, not TimeoutExpired
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=450, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    metrics = [d.get("metric") for d in lines]
+    # CPU-mode headline metric; measured values present, no error lines
+    assert metrics[0] == "bert_tiny_cpu_smoke", metrics
+    assert "fused_layer_norm_fwdbwd_h1024" in metrics, metrics
+    assert not any("error" in d for d in lines), lines
+    # the contract metric is re-emitted LAST (parse-the-tail convention)
+    assert metrics[-1] == "bert_tiny_cpu_smoke", metrics
+    assert len([m for m in metrics if m == "bert_tiny_cpu_smoke"]) == 2
+    assert lines[-1]["value"] > 0
