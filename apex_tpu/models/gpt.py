@@ -304,8 +304,17 @@ def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
                       dropout_rng=rng, ring=ring)
 
     if cfg.remat:
-        pol = (getattr(jax.checkpoint_policies, cfg.remat_policy)
-               if cfg.remat_policy else None)
+        pol = None
+        if cfg.remat_policy:
+            pol = getattr(jax.checkpoint_policies, cfg.remat_policy,
+                          None)
+            # reject dunders and argument-taking factories too — the
+            # policy must be directly usable as jax.checkpoint(policy=)
+            if (cfg.remat_policy.startswith("_") or not callable(pol)):
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r} is not a "
+                    "jax.checkpoint_policies policy (e.g. "
+                    "'dots_saveable', 'nothing_saveable')")
         block = jax.checkpoint(block, policy=pol)
     if dropout_rng is None:
         x, _ = lax.scan(lambda x, lp: (block(lp, x, None), None),

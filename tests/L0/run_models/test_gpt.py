@@ -225,6 +225,33 @@ def test_dropout_active_and_deterministic():
     assert float(l1) != float(l3)
 
 
+def test_remat_policy_selective_matches_and_validates():
+    """remat_policy='dots_saveable' (selective recompute) must be loss-
+    AND grad-identical to full remat — jax.checkpoint changes only WHAT
+    is stored, never the math; a bad policy name fails loudly."""
+    cfg = gpt_tiny()
+    full = type(cfg)(**{**cfg.__dict__, "remat": True})
+    sel = type(cfg)(**{**cfg.__dict__, "remat": True,
+                       "remat_policy": "dots_saveable"})
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    ids, labels = _data(cfg)
+
+    def lg(c):
+        return jax.value_and_grad(
+            lambda p: gpt_loss_unsharded(p, c, ids, labels))(params)
+
+    l1, g1 = lg(full)
+    l2, g2 = lg(sel)
+    assert float(l1) == float(l2)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), g1, g2)
+
+    bad = type(cfg)(**{**cfg.__dict__, "remat": True,
+                       "remat_policy": "not_a_policy"})
+    with pytest.raises(ValueError, match="not_a_policy"):
+        gpt_loss_unsharded(params, bad, ids, labels)
+
+
 def test_bench_hook_smoke():
     from apex_tpu.models.gpt import gpt_tp_bench
 
