@@ -135,15 +135,29 @@ class Amp:
                 for i, s in enumerate(states)}
 
     def load_state_dict(self, d: dict):
+        """Inverse of :meth:`state_dict`. A loss_scaler COUNT mismatch
+        warns and loads the overlap (reference behavior: apex's
+        ``load_state_dict`` iterates ``zip(self._loss_scalers, ...)`` —
+        silently truncating; we keep the load-what-matches semantics but
+        say so out loud): extra checkpoint entries are dropped, missing
+        ones fall back to a fresh ``init_state()``. Raising here would
+        brick every resume-with-changed-loss-count run for a state that
+        is, at worst, a scale-warmup hiccup."""
         keys = sorted((k for k in d if k.startswith("loss_scaler")
                        and k[len("loss_scaler"):].isdigit()),
                       key=lambda k: int(k[len("loss_scaler"):]))
         if len(keys) != self.num_losses:
-            raise ValueError(
+            import warnings
+            warnings.warn(
                 f"amp state_dict has {len(keys)} loss_scaler entries but "
                 f"this handle was initialized with num_losses="
-                f"{self.num_losses}")
-        states = tuple(self.scaler.load_state_dict(d[k]) for k in keys)
+                f"{self.num_losses}; loading the overlap — surplus "
+                "checkpoint entries are ignored, missing scalers start "
+                "from a fresh init_state()", stacklevel=2)
+        states = tuple(
+            self.scaler.load_state_dict(d[keys[i]]) if i < len(keys)
+            else self.scaler.init_state()
+            for i in range(self.num_losses))
         return states[0] if self.num_losses == 1 else states
 
 

@@ -37,6 +37,7 @@ from jax import lax
 
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.utils.compat import axis_size
 from apex_tpu.transformer import tensor_parallel as tp
 from apex_tpu.transformer.functional import (
     flash_attention,
@@ -295,6 +296,23 @@ def _rope_or_none(cfg: GPTConfig, s: int):
     return rope_frequencies(cfg.head_dim, s, cfg.rope_base)
 
 
+# The vetted ZERO-ARG members of jax.checkpoint_policies — directly
+# usable as jax.checkpoint(policy=...). Everything else in that
+# namespace is a factory (verified by signature inspection: the
+# save_*_names / save_from_both_policies / offload_* entries all take
+# arguments and return a policy). hasattr-filtered so the set tracks
+# whichever jax is running.
+_REMAT_POLICIES = frozenset(
+    name for name in (
+        "checkpoint_dots",
+        "checkpoint_dots_with_no_batch_dims",
+        "dots_saveable",
+        "dots_with_no_batch_dims_saveable",
+        "everything_saveable",
+        "nothing_saveable",
+    ) if hasattr(jax.checkpoint_policies, name))
+
+
 def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
                  dropout_rng, ring=False):
     """Depth loop: lax.scan over the stacked layer leaves, optionally
@@ -306,15 +324,21 @@ def _scan_layers(x, layers, cfg, freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
     if cfg.remat:
         pol = None
         if cfg.remat_policy:
-            pol = getattr(jax.checkpoint_policies, cfg.remat_policy,
-                          None)
-            # reject dunders and argument-taking factories too — the
-            # policy must be directly usable as jax.checkpoint(policy=)
-            if (cfg.remat_policy.startswith("_") or not callable(pol)):
+            # allowlist of the ZERO-ARG policies: callability alone
+            # also admits the factory entries (save_only_these_names,
+            # save_and_offload_only_these_names, ...) which ARE callable
+            # but take names/policies, not residuals — jax.checkpoint
+            # would then fail deep inside the scan trace (or worse,
+            # treat the factory as an accept-everything predicate)
+            # instead of at config time
+            if cfg.remat_policy not in _REMAT_POLICIES:
                 raise ValueError(
                     f"remat_policy {cfg.remat_policy!r} is not a "
-                    "jax.checkpoint_policies policy (e.g. "
-                    "'dots_saveable', 'nothing_saveable')")
+                    "zero-arg jax.checkpoint_policies policy; pick one "
+                    f"of {sorted(_REMAT_POLICIES)} (factories like "
+                    "'save_only_these_names' need arguments and are "
+                    "not usable here)")
+            pol = getattr(jax.checkpoint_policies, cfg.remat_policy)
         block = jax.checkpoint(block, policy=pol)
     if dropout_rng is None:
         x, _ = lax.scan(lambda x, lp: (block(lp, x, None), None),
@@ -415,7 +439,7 @@ class GPTModel:
                     cp_rank * s, s, 0)
                 x = x + pos.astype(x.dtype)[None]
             freqs = _rope_or_none(
-                cfg, s * lax.axis_size(ps.CONTEXT_AXIS))
+                cfg, s * axis_size(ps.CONTEXT_AXIS))
             if freqs is not None:
                 freqs = lax.dynamic_slice_in_dim(freqs, cp_rank * s, s, 0)
             if dropout_rng is not None:

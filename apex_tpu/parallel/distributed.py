@@ -64,8 +64,15 @@ class DistributedDataParallel:
         """Per-rank replica of replicated params (call inside shard_map
         before taking grads) — the torch "module replica" of the
         reference; see the module docstring for why this is load-bearing."""
+        pcast = getattr(lax, "pcast", None)
+        if pcast is None:
+            # jax without varying-axes tracking: ps.shard_map runs with
+            # check_rep=False there, so replicated inputs are already
+            # plain per-rank values and the broadcast transpose inserts
+            # no psum — the identity IS the per-rank replica.
+            return params
         return jax.tree.map(
-            lambda p: lax.pcast(p, self.axis_name, to="varying"), params)
+            lambda p: pcast(p, self.axis_name, to="varying"), params)
 
     def allreduce_grads(self, grads: Any) -> Any:
         """psum grads over the data axis (call inside shard_map/pmap).

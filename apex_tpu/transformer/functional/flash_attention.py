@@ -24,8 +24,35 @@ Numerics: softmax in fp32 (scores masked to -1e30, matching the
 ``-10000``-additive convention of the fused softmax kernels for any
 realistically-scaled logits); fully-masked rows return 0 (the
 flash/fmha convention). ``mask`` is (b, s_k) with 1 = attend.
+
+VPU diet (the d=64 lever — BERT-Large's own head shape ran at 18% of
+peak while d=128 hit 38% at identical FLOPs, so the cost is per score
+ELEMENT, not MXU occupancy):
+
+- **base-2 online softmax** (``_EXP2``): ``log2(e)`` is folded into the
+  q prescale that already exists, so every ``exp`` in the three kernels
+  becomes the cheaper ``exp2`` (the hardware primitive ``exp`` lowers
+  to — one fewer VPU multiply per score element per exponential) and
+  the running max / logsumexp live in base 2 end to end. The backward
+  kernels consume the base-2 lse directly (``exp2(s2 - lse2)`` is
+  exactly the base-e probability); the only base conversion anywhere is
+  ONE ln(2) multiply on the final dk tile (see ``_bwd_call`` — dk is
+  ``ds^T @ (scale*log2e*q)``, i.e. log2e too big, and the fixup is
+  d-sized, not s²-sized).
+- **bf16 probability tiles** (``_P_BF16``): p / ds are consumed only by
+  MXU ``dot_general``s, so they are cast to bf16 immediately after the
+  fp32 (m, l) statistics are updated, and the dropout keep/scale ops run
+  on the bf16 tile. m, l, lse, acc stay fp32. With the toggle off the
+  tiles stay fp32 and the other operand is upcast — the measurement
+  variant ``bench.py ab flash_d64_p32`` uses to price the bf16 path.
+  fp32 inputs always keep fp32 tiles (golden-test tolerances are tight).
+
+Dropout masks are position-hashed (``_hash_keep``) and therefore
+bit-identical between forward and backward and across every variant
+toggle — the toggles change arithmetic cost, never randomness.
 """
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -72,6 +99,67 @@ _CAUSAL_MAX_BLOCK = 512
 _CAUSAL_SKIP = False
 _CAUSAL_CLAMP = False
 _DIM_SEMANTICS = True
+
+# VPU-diet toggles (see module docstring). Same contract as the causal
+# toggles above: module-level so `bench.py ab` can trace a legacy-variant
+# callable against the default one IN THE SAME PROCESS — the only
+# comparison that resolves <20% effects on a relay-attached rig. Flip via
+# `kernel_variant(...)`; the toggles are read at TRACE time, so a
+# callable must be traced (first call / warmup) inside the context.
+_EXP2 = True    # base-2 online softmax, log2e folded into the q prescale
+_P_BF16 = True  # bf16 p/ds tiles into the MXU (bf16 operands only)
+
+# Block cap for small head dims. The exp2/bf16-p diet shifts the VPU:MXU
+# ratio at d<128 (the matmuls stay narrow while the per-score VPU cost
+# drops), so the measured-best 512 tile of the pre-exp2 kernels may no
+# longer be optimal — `bench.py ab flash_d64_block256` re-tunes this
+# without a code edit. 512 (= no change) until the driver's A/B says
+# otherwise; _SMALL_D gates which head dims the cap applies to.
+_SMALL_D_MAX_BLOCK = 512
+_SMALL_D = 128
+
+_LOG2E = 1.4426950408889634  # log2(e): folded into the q prescale
+_LN2 = 0.6931471805599453    # 1/log2(e): the one dk fixup multiply
+
+
+@contextlib.contextmanager
+def kernel_variant(**toggles):
+    """Temporarily override module toggles (``exp2``, ``p_bf16``,
+    ``small_d_max_block``, ``causal_skip``, ...). Trace-time only: jit a
+    callable INSIDE the context (fwd and bwd together — e.g. warm a
+    ``jax.grad`` under jit) and the variant is baked into the compiled
+    program; already-compiled programs are unaffected. Used by the
+    same-process A/B harness (``bench.py ab``) and the kernel-parity
+    pinning checks."""
+    mapping = {k: f"_{k.upper()}" for k in toggles}
+    saved = {}
+    for k, attr in mapping.items():
+        if attr not in globals():
+            raise ValueError(f"unknown kernel_variant toggle {k!r}")
+        saved[attr] = globals()[attr]
+        globals()[attr] = toggles[k]
+    try:
+        yield
+    finally:
+        globals().update(saved)
+
+
+def _exp(x):
+    return jnp.exp2(x) if _EXP2 else jnp.exp(x)
+
+
+def _log(x):
+    return jnp.log2(x) if _EXP2 else jnp.log(x)
+
+
+def _mxu_dtype(operand_dtype):
+    """dtype the probability/ds tiles take into an MXU dot against an
+    operand of ``operand_dtype``. bf16 operands: bf16 (default) or fp32
+    (the ``_P_BF16=False`` measurement variant, which upcasts the
+    operand instead). fp32 operands always fp32 — golden-test parity."""
+    if operand_dtype == jnp.bfloat16 and not _P_BF16:
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(operand_dtype)
 
 
 def _cparams():
@@ -170,9 +258,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
 
     def tile(masked):
         def go():
-            # q arrives PRE-SCALED by softmax_scale (folded outside the
-            # kernel — one fewer VPU op per score element; the kernels
-            # are VPU-bound)
+            # q arrives PRE-SCALED by softmax_scale (*log2e under _EXP2)
+            # — folded outside the kernel, so no per-score-element scale
+            # op; scores are base-2 logits and every exp below is exp2
             q, k, v = q_ref[0], k_ref[0], v_ref[0]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
@@ -183,19 +271,27 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
                 s = jnp.where(valid, s, _NEG)
             m_prev = m_ref[:, 0:1]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_cur)
-            p = jnp.exp(s - m_cur)
+            alpha = _exp(m_prev - m_cur)
+            p = _exp(s - m_cur)
             if masked:
                 p = jnp.where(valid, p, 0.0)
+            # (m, l) statistics stay fp32: l sums the fp32 tile BEFORE
+            # the bf16 cast so the normalizer keeps full precision
             l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + jnp.sum(p, -1,
                                                             keepdims=True)
             m_ref[:, 0:1] = m_cur
+            # p is consumed only by the PV matmul from here on — cast to
+            # the MXU dtype now so the dropout keep/scale ops below run
+            # on the narrow tile too (precision loss bounded by the fp32
+            # matmul accumulate)
+            p = p.astype(_mxu_dtype(v.dtype))
             if rate > 0.0:
                 keep = _keep_mask(seed_ref, i, qt * bq, kt * bk,
                                   p.shape, rate)
-                p = jnp.where(keep, p / (1.0 - rate), 0.0)
+                p = jnp.where(keep, p * p.dtype.type(1.0 / (1.0 - rate)),
+                              p.dtype.type(0.0))
             acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                p, v.astype(p.dtype), (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         return go
 
@@ -221,9 +317,12 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
         # without megacore cores clobbering each other's slices of a
         # shared full-row block (a (1,1,sq_p) block indexed (i,0,0) is
         # revisited across qt; on v4/v5p each TensorCore's private copy
-        # would lose the other core's rows on write-back)
+        # would lose the other core's rows on write-back).
+        # Under _EXP2 the stored value is the BASE-2 logsumexp
+        # (m2 + log2 l); the backward kernels consume it as-is — no
+        # base conversion ever happens on an s²-sized tile.
         lse_ref[0, 0, :] = jnp.where(
-            l[:, 0] > 0, m_ref[:, 0] + jnp.log(l[:, 0]), jnp.inf)
+            l[:, 0] > 0, m_ref[:, 0] + _log(l[:, 0]), jnp.inf)
 
 
 # -- backward: dq -----------------------------------------------------------
@@ -248,11 +347,15 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
             lse_row = lse_ref[0, 0, pl.ds(qt * bq, bq)]
             delta_row = delta_ref[0, 0, pl.ds(qt * bq, bq)]
             # q pre-scaled; the kernel emits d(q*scale) and the caller
-            # multiplies the final dq by softmax_scale once
+            # multiplies the final dq by softmax_scale once. Under _EXP2
+            # s and lse_row are both base-2, so exp2(s - lse2) is the
+            # base-e probability and ds needs NO base fixup here (dL/ds
+            # is taken w.r.t. the base-e logit, whose gradient path the
+            # caller's single scale multiply completes).
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            p = jnp.exp(s - lse_row[:, None])
+            p = _exp(s - lse_row[:, None])
             if masked:
                 valid = _score_mask(
                     s, qt, kt, mask_ref[0, 0, :] if has_mask else None,
@@ -265,8 +368,9 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
                                   p.shape, rate)
                 dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
             ds = p * (dp - delta_row[:, None])
+            dsd = _mxu_dtype(k.dtype)
             dq_acc[:] += jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                ds.astype(dsd), k.astype(dsd), (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         return go
 
@@ -308,34 +412,41 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, do_ref,
             q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
             lse_row = lse_ref[0, 0, pl.ds(qt * bq, bq)]
             delta_row = delta_ref[0, 0, pl.ds(qt * bq, bq)]
-            # q pre-scaled: dk = ds^T @ (scale*q) needs NO adjustment
+            # q pre-scaled: dk = ds^T @ (scale*q); under _EXP2 the
+            # prescale carries an extra log2e, so the caller multiplies
+            # the FINAL dk tile by ln2 once (d-sized, not s²-sized)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            p = jnp.exp(s - lse_row[:, None])
+            p = _exp(s - lse_row[:, None])
             if masked:
                 valid = _score_mask(
                     s, qt, kt, mask_ref[0, 0, :] if has_mask else None,
                     sk if pad else None, causal)
                 p = jnp.where(valid, p, 0.0)
+            # p feeds only the dv matmul past this point (ds re-derives
+            # from the fp32 copy below) — bf16 tile for keep/scale + MXU
+            pd = _mxu_dtype(do.dtype)
             if rate > 0.0:
                 keep = _keep_mask(seed_ref, i, qt * bq, kt * bk,
                                   p.shape, rate)
-                p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+                p_drop = jnp.where(
+                    keep, p.astype(pd) * pd.type(1.0 / (1.0 - rate)),
+                    pd.type(0.0))
             else:
-                p_drop = p
+                p_drop = p.astype(pd)
             # dv += p_drop^T @ do
             dv_acc[:] += jax.lax.dot_general(
-                p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                p_drop, do.astype(pd), (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             if rate > 0.0:
                 dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
             ds = p * (dp - delta_row[:, None])
-            # dk += ds^T @ (scale*q) — the pre-scale supplies the factor
+            dsd = _mxu_dtype(q.dtype)
             dk_acc[:] += jax.lax.dot_general(
-                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                ds.astype(dsd), q.astype(dsd), (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         return go
 
@@ -405,8 +516,21 @@ def _clamp_kt(causal, bq, bk):
 
 def _prescale_q(q3, scale):
     """Fold softmax_scale into q (fp32 multiply, one rounding back to
-    the storage dtype) so no kernel pays a per-score-element scale op."""
+    the storage dtype) so no kernel pays a per-score-element scale op.
+    Under _EXP2 the SAME multiply also carries log2(e): the kernels'
+    score tiles come out as base-2 logits for free."""
+    if _EXP2:
+        scale = scale * _LOG2E
     return (q3.astype(jnp.float32) * jnp.float32(scale)).astype(q3.dtype)
+
+
+def _maxb(causal, d):
+    """Block-size cap: the causal-skip cap when tile skipping is on, the
+    small-head-dim cap below _SMALL_D (see the toggle comments)."""
+    maxb = _CAUSAL_MAX_BLOCK if (causal and _CAUSAL_SKIP) else 512
+    if d < _SMALL_D:
+        maxb = min(maxb, _SMALL_D_MAX_BLOCK)
+    return maxb
 
 
 def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
@@ -414,7 +538,7 @@ def _fwd_call(q, k, v, mask, *, causal, scale, rate, seed, interpret):
     sk = k.shape[2]
     q3, k3, v3, m3, sq_p, sk_p, d_p = _prep(q, k, v, mask, b, h)
     q3 = _prescale_q(q3, scale)
-    maxb = _CAUSAL_MAX_BLOCK if (causal and _CAUSAL_SKIP) else 512
+    maxb = _maxb(causal, d)
     bq, bk = _block(sq_p, maxb), _block(sk_p, maxb)
     grid = (b * h, sq_p // bq, sk_p // bk)
     sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
@@ -458,7 +582,7 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
                     -1)[:, None, :]  # (bh, 1, sq_p) like lse
     sd = jnp.asarray(seed, jnp.uint32).reshape(1, 2)
 
-    maxb = _CAUSAL_MAX_BLOCK if (causal and _CAUSAL_SKIP) else 512
+    maxb = _maxb(causal, d)
     bq, bk = _block(sq_p, maxb), _block(sk_p, maxb)
     ckt = _clamp_kt(causal, bq, bk)
     row_spec = pl.BlockSpec((1, 1, sq_p), lambda i, qt, kt: (i, 0, 0),
@@ -516,7 +640,14 @@ def _bwd_call(q, k, v, mask, out, lse_p, do, *, causal, scale, rate, seed,
     # dq kernel produced d(scale*q); one fused XLA multiply finishes it
     dq = (dq[:, :sq, :d].astype(jnp.float32) * jnp.float32(scale)
           ).astype(q.dtype).reshape(b, h, sq, d)
-    dk = dk[:, :sk, :d].reshape(b, h, sk, d)
+    dk = dk[:, :sk, :d]
+    if _EXP2:
+        # the dkv kernel's dk = ds^T @ (scale*log2e*q) — one ln(2)
+        # multiply on the final (s, d) tile undoes the log2e (the ONLY
+        # base-conversion cost of the base-2 softmax; it fuses with the
+        # slice above)
+        dk = (dk.astype(jnp.float32) * jnp.float32(_LN2)).astype(k.dtype)
+    dk = dk.reshape(b, h, sk, d)
     dv = dv[:, :sk, :d].reshape(b, h, sk, d)
     return dq, dk, dv
 

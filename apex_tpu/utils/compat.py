@@ -1,0 +1,21 @@
+"""Version-portability shims for jax API renames.
+
+The CI rig pins an older jax than the driver; every shim here keeps ONE
+call site per renamed API so the rest of the package never branches on
+jax versions. (Siblings: ``utils.pallas.dimsem`` for the
+``TPUCompilerParams`` rename, ``transformer.parallel_state.shard_map``
+for the ``check_rep``/``check_vma`` rename.)
+"""
+
+from jax import lax
+
+
+def axis_size(name):
+    """``lax.axis_size`` where available; on older jax, ``psum(1, name)``
+    — constant-folded to the concrete mesh size at trace time, and
+    raising the same trace-time ``NameError`` when ``name`` is unbound
+    (verified on 0.4.37), so bound-axis probes behave identically."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    return lax.psum(1, name)

@@ -37,4 +37,8 @@ def dimsem(*sem):
     one core's partial writes."""
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(dimension_semantics=sem)
+    # jax renamed TPUCompilerParams -> CompilerParams; support both so the
+    # kernels import on every rig (CI pins an older jax than the driver)
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=sem)

@@ -27,9 +27,15 @@ def apply_test_platform_override() -> bool:
         return False
     jax.config.update("jax_platforms", plat)
     if plat == "cpu":
-        jax.config.update(
-            "jax_num_cpu_devices",
-            int(os.environ.get("APEX_TPU_TEST_NUM_DEVICES", "8")))
+        n = int(os.environ.get("APEX_TPU_TEST_NUM_DEVICES", "8"))
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except AttributeError:
+            # older jax: fall back to the XLA flag (read at backend
+            # init, so this still works when called before device use)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n}")
     return True
 
 

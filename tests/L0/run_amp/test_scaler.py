@@ -110,3 +110,36 @@ def test_amp_multi_loss_state_dict_roundtrip():
     assert not isinstance(s, tuple)
     assert set(h1.state_dict(s)) == {"loss_scaler0"}
     assert not isinstance(h1.load_state_dict(h1.state_dict(s)), tuple)
+
+
+def test_amp_load_state_dict_count_mismatch_warns_and_loads_overlap():
+    """A checkpoint whose loss_scaler count disagrees with num_losses
+    must not brick the resume: warn, load the overlap, fresh-init the
+    rest (reference apex silently truncates via zip; we keep the
+    semantics and surface the warning)."""
+    import warnings
+
+    h3 = amp.initialize("O2", loss_scale="dynamic", num_losses=3,
+                        verbosity=0)
+    states = h3.init_state()
+    states = (h3.update_scale(states[0], jnp.asarray(True)),) + states[1:]
+    d3 = h3.state_dict(states)
+
+    # fewer checkpoint entries than losses: overlap loads, rest fresh
+    d1 = {"loss_scaler0": d3["loss_scaler0"]}
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        back = h3.load_state_dict(d1)
+    assert any("loss_scaler" in str(x.message) for x in w)
+    assert isinstance(back, tuple) and len(back) == 3
+    assert float(back[0].loss_scale) == 2.0 ** 15  # loaded (halved)
+    assert float(back[1].loss_scale) == 2.0 ** 16  # fresh init
+
+    # more checkpoint entries than losses: surplus ignored
+    h1 = amp.initialize("O2", loss_scale="dynamic", verbosity=0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        s1 = h1.load_state_dict(d3)
+    assert any("loss_scaler" in str(x.message) for x in w)
+    assert not isinstance(s1, tuple)
+    assert float(s1.loss_scale) == 2.0 ** 15  # scaler 0's state

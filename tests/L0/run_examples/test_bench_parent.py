@@ -35,3 +35,32 @@ def test_parent_runs_headline_first_and_reemits_it_last():
     assert metrics[-1] == "bert_tiny_cpu_smoke", metrics
     assert len([m for m in metrics if m == "bert_tiny_cpu_smoke"]) == 2
     assert lines[-1]["value"] > 0
+
+
+def test_ab_mode_contract():
+    """`bench.py ab <pair>` — the same-process A/B instrument's output
+    contract (ratio + band + absolute medians), pinned on the cheapest
+    pair so the driver-side ab_kernels config can be trusted blind."""
+    env = dict(os.environ,
+               APEX_TPU_TEST_PLATFORM="cpu",
+               APEX_TPU_TEST_NUM_DEVICES="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "ab",
+         "ln_h1024"],
+        capture_output=True, text=True, timeout=450, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert [d["metric"] for d in lines] == ["ab_ln_h1024"], lines
+    d = lines[0]
+    assert not d.get("error"), d
+    lo, hi = d["band"]
+    assert lo <= d["value"] <= hi, d
+    assert d["a_us"] > 0 and d["b_us"] > 0
+    assert d["a_wins"] == (d["value"] < 1.0)
+    # unknown pair names error-line instead of dying
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "ab", "nope"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert r2.returncode == 0
+    assert "unknown ab pair" in r2.stdout

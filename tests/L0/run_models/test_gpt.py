@@ -20,7 +20,11 @@ from apex_tpu.models.gpt import (
 )
 from apex_tpu.transformer import parallel_state as ps
 
-B, S = 4, 32
+# B stays 4 (num_microbatches=4 needs B divisible by 4); S=16 halves
+# the attention/scan work of every config vs the original 32 and keeps
+# the SP divisibility (tp=2 | S) intact — suite-time satellite of the
+# d=64 PR
+B, S = 4, 16
 
 
 def _data(cfg):
@@ -250,6 +254,14 @@ def test_remat_policy_selective_matches_and_validates():
                        "remat_policy": "not_a_policy"})
     with pytest.raises(ValueError, match="not_a_policy"):
         gpt_loss_unsharded(params, bad, ids, labels)
+
+    # factory members of jax.checkpoint_policies ARE callable but take
+    # names/policies, not residuals — the allowlist must reject them at
+    # config time, not let jax.checkpoint fail deep inside the scan
+    factory = type(cfg)(**{**cfg.__dict__, "remat": True,
+                           "remat_policy": "save_only_these_names"})
+    with pytest.raises(ValueError, match="save_only_these_names"):
+        gpt_loss_unsharded(params, factory, ids, labels)
 
 
 def test_bench_hook_smoke():

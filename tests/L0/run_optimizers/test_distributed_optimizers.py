@@ -20,11 +20,15 @@ DP = 8
 
 
 def make_params(key):
+    # sized to stay meaningful on the DP=8 mesh while keeping the suite
+    # fast: emb (64x32 = 2048 elems = 16 flat 128-rows) still spans
+    # several of the 8 shards (the trust-ratio and checkpoint tests
+    # depend on that), "scale" stays deliberately non-128-aligned
     k1, k2, k3 = jax.random.split(key, 3)
     return {
-        "dense": {"w": jax.random.normal(k1, (64, 32)),
-                  "b": jnp.zeros((32,))},
-        "emb": jax.random.normal(k2, (100, 64)) * 0.1,
+        "dense": {"w": jax.random.normal(k1, (32, 16)),
+                  "b": jnp.zeros((16,))},
+        "emb": jax.random.normal(k2, (64, 32)) * 0.1,
         "scale": jax.random.normal(k3, (7,)),
     }
 
@@ -163,9 +167,9 @@ def test_grad_scale_unscales():
 
 
 def test_lamb_trust_ratio_spans_shards():
-    """A tensor bigger than one shard (emb: 100x64 = 50 rows over 8 ranks)
-    still gets ONE coherent trust ratio — compare against FusedLAMB where
-    each leaf is a whole tensor."""
+    """A tensor bigger than one shard (emb: 64x32 = 16 flat rows over 8
+    ranks) still gets ONE coherent trust ratio — compare against
+    FusedLAMB where each leaf is a whole tensor."""
     mesh = dp_mesh()
     params = make_params(jax.random.PRNGKey(3))
     gs = per_rank_grads(jax.random.PRNGKey(4), params)

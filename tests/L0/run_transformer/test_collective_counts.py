@@ -105,9 +105,10 @@ def test_tp_mlp_backward_adds_exactly_one_allreduce():
     # transpose) + the Row bias cotangent psum (bias-sized — shard_map's
     # transpose rule for a replicated input; Megatron computes that grad
     # rank-locally, but 16 floats of AR is noise next to the activation
-    # AR, so the structure is pinned rather than fought)
+    # AR, so the structure is pinned rather than fought). Older XLA
+    # doesn't combine two of the same-kind sums -> 4 launches there.
     c = _counts(jax.grad(loss, argnums=(0, 1, 2)), cp, rp, x)
-    assert c["all-reduce"] == 3, c
+    assert c["all-reduce"] in (3, 4), c
     assert c["all-gather"] == 0 and c["reduce-scatter"] == 0, c
 
 
@@ -158,17 +159,21 @@ def test_vocab_parallel_ce_fwd_allreduces_zero_bwd():
             in_specs=(P(None, ps.TENSOR_AXIS), P()),
             out_specs=P())(lg, tg)
 
-    # three semantic psums (max, sum-exp, target logit); XLA's combiner
-    # merges the two same-kind sums into one op -> 2 launches
+    # three semantic psums (max, sum-exp, target logit); newer XLA's
+    # combiner merges the two same-kind sums into one op -> 2 launches,
+    # older XLA leaves all 3
     c = _counts(fwd, logits, target)
-    assert c["all-reduce"] == 2, c
+    assert c["all-reduce"] in (2, 3), c
 
     def loss(lg):
         return jnp.sum(fwd(lg, target))
 
     cg = _counts(jax.grad(loss), logits)
     # backward is shard-local: no NEW collectives beyond the forward's
-    assert cg["all-reduce"] == 2, cg
+    # (the larger grad program can give the combiner MORE merge
+    # opportunities, so <= rather than ==)
+    assert cg["all-reduce"] <= c["all-reduce"], (c, cg)
+    assert cg["all-reduce"] in (2, 3), cg
 
 
 def test_1f1b_two_collective_permutes_per_tick():

@@ -43,7 +43,7 @@ def test_unscale_works_on_tensor_only_shard_map():
         return grads["g"], found_inf.astype(jnp.int32).reshape(1)
 
     g = jnp.ones((2, 4), jnp.float32)
-    out, found = jax.shard_map(
+    out, found = ps.shard_map(
         f, mesh=mesh, in_specs=(P(ps.TENSOR_AXIS),),
         out_specs=(P(ps.TENSOR_AXIS), P(ps.TENSOR_AXIS)))(g)
     assert np.asarray(found).tolist() == [0, 0]

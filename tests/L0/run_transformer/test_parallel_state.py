@@ -9,7 +9,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from apex_tpu.transformer import parallel_state as ps
 
@@ -60,12 +59,11 @@ def test_ranks_inside_shard_map():
         dp_r = ps.get_data_parallel_rank()
         return x + tp_r * 100 + pp_r * 10 + dp_r
 
-    out = shard_map(
+    out = ps.shard_map(
         f,
         mesh=mesh,
         in_specs=P("data", None),
         out_specs=P("data", None),
-        check_vma=False,
     )(jnp.zeros((2, 4)))
     # rows belong to dp ranks 0,1; within a row all tp/pp combos... rows are
     # sharded over data only, so each dp shard sees its own dp rank; the

@@ -20,7 +20,15 @@ import pytest
 _platform = os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu")
 jax.config.update("jax_platforms", _platform)
 if _platform == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: the option doesn't exist — the XLA flag read at
+        # backend init does the same job (works as long as no device has
+        # been touched yet, which conftest import order guarantees)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 
 # Persistent compilation cache: the suite's wall time is dominated by XLA
 # compiles on this host's single CPU core, and most test programs are
