@@ -53,7 +53,13 @@ class Amp:
         self.scaler = LossScaler(loss_scale=properties.loss_scale)
 
     # -- model / input casting -----------------------------------------
-    def cast_model(self, params: Any) -> Any:
+    def cast_model(self, params: Any, precast: Any = None) -> Any:
+        """O2/O3 model cast. ``precast`` is an optimizer-emitted compute
+        tree (``FusedAdam(emit_compute_params=True)`` etc.): matching-
+        dtype leaves are consumed verbatim so the per-step fp32→bf16
+        re-cast over the master tree disappears; only leaves the policy
+        keeps fp32 (norms under ``keep_batchnorm_fp32``) still come from
+        ``params``."""
         p = self.properties
         if p.cast_model_type is None:
             return params
@@ -61,6 +67,7 @@ class Amp:
             params,
             p.cast_model_type,
             keep_batchnorm_fp32=bool(p.keep_batchnorm_fp32),
+            precast=precast,
         )
 
     def cast_input(self, batch: Any) -> Any:

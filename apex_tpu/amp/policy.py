@@ -40,23 +40,31 @@ def cast_params(
     dtype,
     keep_batchnorm_fp32: bool = False,
     norm_predicate: Optional[Callable[[tuple], bool]] = None,
+    precast: Optional[Any] = None,
 ) -> Any:
     """Cast floating leaves of a param tree to ``dtype`` (O2/O3 model cast).
 
     With ``keep_batchnorm_fp32``, leaves whose path looks like a
     normalization parameter stay fp32 (ref: ``_initialize`` skipping
-    ``_BatchNorm`` modules).
+    ``_BatchNorm`` modules). ``precast`` (an optimizer's fused cast-out
+    tree) short-circuits the per-leaf cast wherever its dtype already
+    matches the target — the O2 per-step model cast then reads no master
+    bytes for those leaves.
     """
     pred = norm_predicate or default_norm_predicate
 
-    def cast(path, x):
+    def cast(path, x, *pre):
         if not _is_float_leaf(x):
             return x
-        if keep_batchnorm_fp32 and pred(path):
-            return x.astype(jnp.float32)
-        return x.astype(dtype)
+        target = jnp.float32 if (keep_batchnorm_fp32 and pred(path)) \
+            else jnp.dtype(dtype)
+        if pre and getattr(pre[0], "dtype", None) == target:
+            return pre[0]
+        return x.astype(target)
 
-    return jax.tree_util.tree_map_with_path(cast, params)
+    if precast is None:
+        return jax.tree_util.tree_map_with_path(cast, params)
+    return jax.tree_util.tree_map_with_path(cast, params, precast)
 
 
 def cast_inputs(batch: Any, dtype) -> Any:
@@ -77,10 +85,27 @@ def master_params(params: Any) -> Any:
 def model_params_from_master(
     master: Any,
     like: Any,
+    precast: Optional[Any] = None,
 ) -> Any:
-    """Re-cast master weights to the dtypes of the compute tree ``like``."""
+    """Re-cast master weights to the dtypes of the compute tree ``like``.
+
+    ``precast`` is an optimizer-emitted compute tree (the fused cast-out
+    of ``emit_compute_params``): leaves whose dtype already matches
+    ``like`` are taken verbatim — no fp32 read of the master — and only
+    mismatched leaves (e.g. keep-fp32 norms against a uniform-bf16
+    emission) fall back to casting ``master``.
+    """
+    if precast is None:
+        return jax.tree_util.tree_map(
+            lambda m, l: m.astype(l.dtype) if hasattr(l, "dtype") else m,
+            master,
+            like,
+        )
     return jax.tree_util.tree_map(
-        lambda m, l: m.astype(l.dtype) if hasattr(l, "dtype") else m,
+        lambda m, l, c: (c if getattr(c, "dtype", None) == l.dtype
+                         else m.astype(l.dtype)) if hasattr(l, "dtype")
+        else m,
         master,
         like,
+        precast,
     )

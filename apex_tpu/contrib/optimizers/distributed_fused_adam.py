@@ -37,14 +37,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.multi_tensor_apply import flatten as _flatten
-from apex_tpu.optimizers._common import f32, select_finite
+from apex_tpu.optimizers._common import check_m_dtype, f32, select_finite
 from apex_tpu.transformer import parallel_state as ps
 
 
 class DistributedAdamState(NamedTuple):
     step: jax.Array
     master: jax.Array   # (R, 128) fp32 — shard over rows at rest
-    m: jax.Array        # (R, 128) fp32
+    m: jax.Array        # (R, 128) fp32 or bf16 (``m_dtype``)
     v: jax.Array        # (R, 128) fp32
 
 
@@ -66,8 +66,13 @@ class DistributedFusedAdam:
                  weight_decay: float = 0.0, *,
                  average_grads: bool = True,
                  dp_size: Optional[int] = None,
-                 axis_name: str = ps.DATA_AXIS):
+                 axis_name: str = ps.DATA_AXIS,
+                 m_dtype=jnp.float32):
         self.lr = lr
+        # reduced-precision first moment: the bf16 shard halves m's share
+        # of the at-rest state (see ``state_bytes_per_device``); the
+        # update still accumulates in fp32 and stores round-to-nearest.
+        self.m_dtype = check_m_dtype(m_dtype)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -95,7 +100,8 @@ class DistributedFusedAdam:
                                              dtype=jnp.float32)
         return DistributedAdamState(
             step=jnp.zeros((), jnp.int32), master=master,
-            m=jnp.zeros_like(master), v=jnp.zeros_like(master))
+            m=jnp.zeros(master.shape, self.m_dtype),
+            v=jnp.zeros_like(master))
 
     def partition_spec(self) -> DistributedAdamState:
         """PartitionSpecs for the state pytree (shard_map in_specs /
@@ -141,14 +147,15 @@ class DistributedFusedAdam:
         p32 = state.master
         if not self.adam_w_mode:
             g = g + wd * p32
-        m = b1 * state.m + (1.0 - b1) * g
+        m = b1 * state.m.astype(jnp.float32) + (1.0 - b1) * g
         v = b2 * state.v + (1.0 - b2) * g * g
         u = (m / c1) / (jnp.sqrt(v / c2) + eps)
         if self.adam_w_mode:
             u = u + wd * p32
         master = p32 - lr * u
 
-        new_state = DistributedAdamState(step=t, master=master, m=m, v=v)
+        new_state = DistributedAdamState(
+            step=t, master=master, m=m.astype(self.m_dtype), v=v)
         if found_inf is not None:
             # a rank-local overflow must skip the step EVERYWHERE — the
             # shards are disjoint, so OR across the data group first
@@ -163,6 +170,8 @@ class DistributedFusedAdam:
         return new_params, new_state
 
     def state_bytes_per_device(self, params: Any) -> int:
-        """Per-device optimizer-state bytes at rest (the ~1/dp claim)."""
+        """Per-device optimizer-state bytes at rest (the ~1/dp claim):
+        master + v at 4 bytes each, m at ``m_dtype`` width."""
         _, _, spec = self._layout(params)
-        return 3 * (spec.total_rows // self.dp) * _flatten.LANES * 4
+        per_elem = 4 + 4 + jnp.dtype(self.m_dtype).itemsize
+        return per_elem * (spec.total_rows // self.dp) * _flatten.LANES

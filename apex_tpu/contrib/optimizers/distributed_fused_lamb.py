@@ -27,14 +27,14 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     _check_shardable,
 )
 from apex_tpu.multi_tensor_apply import flatten as _flatten
-from apex_tpu.optimizers._common import f32, select_finite
+from apex_tpu.optimizers._common import check_m_dtype, f32, select_finite
 from apex_tpu.transformer import parallel_state as ps
 
 
 class DistributedLambState(NamedTuple):
     step: jax.Array
     master: jax.Array
-    m: jax.Array
+    m: jax.Array       # fp32 or bf16 (``m_dtype``)
     v: jax.Array
 
 
@@ -48,8 +48,10 @@ class DistributedFusedLAMB:
                  max_grad_norm: float = 1.0, use_nvlamb: bool = False, *,
                  average_grads: bool = True,
                  dp_size: Optional[int] = None,
-                 axis_name: str = ps.DATA_AXIS):
+                 axis_name: str = ps.DATA_AXIS,
+                 m_dtype=jnp.float32):
         self.lr = lr
+        self.m_dtype = check_m_dtype(m_dtype)
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -85,7 +87,8 @@ class DistributedFusedLAMB:
                                              dtype=jnp.float32)
         return DistributedLambState(
             step=jnp.zeros((), jnp.int32), master=master,
-            m=jnp.zeros_like(master), v=jnp.zeros_like(master))
+            m=jnp.zeros(master.shape, self.m_dtype),
+            v=jnp.zeros_like(master))
 
     def partition_spec(self) -> DistributedLambState:
         from jax.sharding import PartitionSpec as P
@@ -137,7 +140,7 @@ class DistributedFusedLAMB:
         p32 = state.master
         if not self.adam_w_mode:
             g = g + wd * p32
-        m = b1 * state.m + beta3 * g
+        m = b1 * state.m.astype(jnp.float32) + beta3 * g
         v = b2 * state.v + (1.0 - b2) * g * g
         u = (m / c1) / (jnp.sqrt(v / c2) + eps)
         if self.adam_w_mode:
@@ -156,7 +159,8 @@ class DistributedFusedLAMB:
             ratio = jnp.where(wd == 0.0, jnp.ones_like(ratio), ratio)
         master = p32 - lr * ratio[local_ids][:, None] * u
 
-        new_state = DistributedLambState(step=t, master=master, m=m, v=v)
+        new_state = DistributedLambState(
+            step=t, master=master, m=m.astype(self.m_dtype), v=v)
         if found_inf is not None:
             found_inf = lax.pmax(
                 jnp.asarray(found_inf).astype(jnp.int32), ax) > 0
@@ -169,4 +173,5 @@ class DistributedFusedLAMB:
 
     def state_bytes_per_device(self, params: Any) -> int:
         _, _, spec, _ = self._layout(params)
-        return 3 * (spec.total_rows // self.dp) * _flatten.LANES * 4
+        per_elem = 4 + 4 + jnp.dtype(self.m_dtype).itemsize
+        return per_elem * (spec.total_rows // self.dp) * _flatten.LANES

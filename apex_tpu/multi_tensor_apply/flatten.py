@@ -91,6 +91,13 @@ def flatten_tensors(tensors: Sequence[jax.Array], spec: FlatSpec = None,
     return jnp.concatenate(parts).reshape(spec.total_rows, LANES), spec
 
 
+def zeros_buffer(spec: FlatSpec, dtype=jnp.float32) -> jax.Array:
+    """A zeroed flat buffer for ``spec`` in ``dtype`` — the per-slot dtype
+    entry point for reduced-precision optimizer state (e.g. a bf16 first
+    moment living beside fp32 master/``v`` buffers of the same layout)."""
+    return jnp.zeros((spec.total_rows, LANES), dtype)
+
+
 def unflatten_tensors(buf: jax.Array, spec: FlatSpec,
                       cast_back: bool = True) -> List[jax.Array]:
     """Slice a flat buffer back into tensors (ref: ``apex_C.unflatten``)."""

@@ -12,6 +12,18 @@ HBM, matching the one-kernel-per-step property of ``csrc/multi_tensor_adam.cu``.
 
 Hyperparameters arrive as a ``(1, N)`` fp32 array in SMEM so that traced
 values (schedules, dynamic loss scale) never trigger recompilation.
+
+Reduced-precision state: the first-moment buffer of Adam/LAMB/NovoGrad (and
+the SGD momentum buffer) may be bf16 — kernels load it with an fp32 upcast,
+accumulate in fp32, and store back in the buffer's own dtype (plain
+round-to-nearest-even, no stochastic rounding; the fp32 master keeps the
+update unbiased enough — see ``docs/source/optimizer_states.rst``). ``v``
+stays fp32 always. BLOCK_ROWS=256 is divisible by the bf16 min-tile
+sublane count (16), so bf16 buffers reuse the same ``(256, 128)`` grid.
+The optimizer kernels can additionally emit the updated params pre-cast to
+a compute dtype (``emit_compute_dtype=jnp.bfloat16``) as one extra output
+written from registers — the fused cast-out that lets amp-O2 skip its
+separate fp32→bf16 ``model_params_from_master`` pass over the master tree.
 """
 
 import functools
@@ -173,7 +185,7 @@ def flat_l2norm(buf: jax.Array, interpret: Optional[bool] = None) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _adam_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
-                 p_out, m_out, v_out):
+                 p_out, m_out, v_out, *pc_out):
     lr = sc_ref[0, 0]
     b1 = sc_ref[0, 1]
     b2 = sc_ref[0, 2]
@@ -186,19 +198,22 @@ def _adam_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
 
     g = g_ref[:].astype(jnp.float32) * grad_scale
     p = p_ref[:]
-    m = m_ref[:]
+    m = m_ref[:].astype(jnp.float32)   # fp32 accumulate for bf16 moments
     v = v_ref[:]
 
     g_l2 = g + (1.0 - adam_w) * wd * p
     m = b1 * m + (1.0 - b1) * g_l2
     v = b2 * v + (1.0 - b2) * g_l2 * g_l2
     update = (m / c1) / (jnp.sqrt(v / c2) + eps) + adam_w * wd * p
-    p_out[:] = p - lr * update
-    m_out[:] = m
+    p_new = p - lr * update
+    p_out[:] = p_new
+    m_out[:] = m.astype(m_out.dtype)
     v_out[:] = v
+    if pc_out:  # fused cast-out: compute params written from registers
+        pc_out[0][:] = p_new.astype(pc_out[0].dtype)
 
 
-def _sgd_kernel(sc_ref, g_ref, p_ref, buf_ref, p_out, buf_out):
+def _sgd_kernel(sc_ref, g_ref, p_ref, buf_ref, p_out, buf_out, *pc_out):
     lr = sc_ref[0, 0]
     mom = sc_ref[0, 1]
     damp = sc_ref[0, 2]
@@ -211,26 +226,31 @@ def _sgd_kernel(sc_ref, g_ref, p_ref, buf_ref, p_out, buf_out):
 
     g = g_ref[:].astype(jnp.float32) * grad_scale
     p = p_ref[:]
-    buf = buf_ref[:]
+    buf = buf_ref[:].astype(jnp.float32)
 
     g = g + (1.0 - wd_after) * wd * p
     seeded = jnp.where(first > 0, g, mom * buf + (1.0 - damp) * g)
     d_mom = jnp.where(nesterov > 0, g + mom * seeded, seeded)
     d = jnp.where(use_mom > 0, d_mom, g)
-    buf_out[:] = jnp.where(use_mom > 0, seeded, buf)
+    buf_out[:] = jnp.where(use_mom > 0, seeded, buf).astype(buf_out.dtype)
     d = d + wd_after * wd * p
-    p_out[:] = p - lr * d
+    p_new = p - lr * d
+    p_out[:] = p_new
+    if pc_out:
+        pc_out[0][:] = p_new.astype(pc_out[0].dtype)
 
 
 def flat_sgd(grads: jax.Array, params: jax.Array, momentum_buf: jax.Array,
              *, lr, momentum: float, dampening: float, weight_decay,
              nesterov: bool, wd_after_momentum: bool, first_run,
-             grad_scale=1.0, interpret: Optional[bool] = None
-             ) -> Tuple[jax.Array, jax.Array]:
-    """One fused SGD step over flat fp32 buffers (ref:
+             grad_scale=1.0, emit_compute_dtype=None,
+             interpret: Optional[bool] = None):
+    """One fused SGD step over flat buffers (ref:
     ``csrc/multi_tensor_sgd_kernel.cu`` incl. the ``first_run`` buffer
     seeding and ``wd_after_momentum``). ``params``/``momentum_buf`` alias
-    in place; ``first_run`` may be a traced bool."""
+    in place; ``first_run`` may be a traced bool. ``momentum_buf`` may be
+    bf16 (fp32 accumulate); ``emit_compute_dtype`` appends the fused
+    cast-out output (return grows to ``(p, buf, compute)``)."""
     rows = params.shape[0]
     gp, pp, bp = (_pad_to_block(b) for b in (grads, params, momentum_buf))
     n_tiles = pp.shape[0] // BLOCK_ROWS
@@ -243,17 +263,21 @@ def flat_sgd(grads: jax.Array, params: jax.Array, momentum_buf: jax.Array,
         jnp.asarray(grad_scale, jnp.float32),
         jnp.float32(1.0 if momentum > 0 else 0.0),
     ]).reshape(1, 9)
-    p_new, b_new = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+                 jax.ShapeDtypeStruct(pp.shape, bp.dtype)]
+    if emit_compute_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct(pp.shape, emit_compute_dtype))
+    outs = pl.pallas_call(
         _sgd_kernel,
         grid=(n_tiles,),
         in_specs=[_smem_spec()] + [_tile_spec()] * 3,
-        out_specs=[_tile_spec()] * 2,
-        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        out_specs=[_tile_spec()] * len(out_shape),
+        out_shape=out_shape,
         input_output_aliases={2: 0, 3: 1},
         compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, bp)
-    return p_new[:rows], b_new[:rows]
+    return tuple(o[:rows] for o in outs)
 
 
 # ---------------------------------------------------------------------------
@@ -274,14 +298,14 @@ def _lamb_stage1_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
 
     g = g_ref[:].astype(jnp.float32) * gs_over_clip
     p = p_ref[:]
-    m = m_ref[:]
+    m = m_ref[:].astype(jnp.float32)   # fp32 accumulate for bf16 moments
     v = v_ref[:]
 
     g_l2 = g + (1.0 - adam_w) * wd * p
     m = b1 * m + beta3 * g_l2
     v = b2 * v + (1.0 - b2) * g_l2 * g_l2
     u = (m / c1) / (jnp.sqrt(v / c2) + eps) + adam_w * wd * p
-    m_out[:] = m
+    m_out[:] = m.astype(m_out.dtype)
     v_out[:] = v
     u_out[:] = u
     # fused stage-2 preamble: per-(8,128)-sub-tile ||p||², ||u||² partials
@@ -296,16 +320,19 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
               adam_w_mode: bool = True, grad_averaging: bool = True,
               bias_correction: bool = True, use_nvlamb: bool = False,
               max_grad_norm: float = 1.0, grad_scale=1.0,
-              grad_norm=None, interpret: Optional[bool] = None
-              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Fused LAMB step over flat fp32 buffers, following the CUDA
+              grad_norm=None, emit_compute_dtype=None,
+              interpret: Optional[bool] = None):
+    """Fused LAMB step over flat buffers, following the CUDA
     two-stage split: stage 1 (one Pallas pass) produces moments, the raw
     update AND the per-sub-tile ||p||²/||u||² partials; the per-tensor
     trust-ratio combine (segment-sum + ratio) and the stage-2
     ``p -= lr·ratio·u`` are XLA elementwise/reduction ops that fuse into
     two trivial passes. ``tile_ids`` is ``FlatSpec.tile_tensor_ids(8)``.
     The global grad-norm clip uses one ``flat_l2norm`` pre-pass over the
-    scaled grads (the reference likewise pre-reduces)."""
+    scaled grads (the reference likewise pre-reduces). ``m`` may be bf16
+    (fp32 accumulate in stage 1); ``emit_compute_dtype`` appends the
+    cast-out params to the return (the cast fuses into the XLA stage-2
+    pass — no extra read of the fp32 params)."""
     rows = params.shape[0]
     gs = jnp.asarray(grad_scale, jnp.float32)
     if grad_norm is None:
@@ -337,7 +364,9 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
         grid=(n_tiles,),
         in_specs=[_smem_spec()] + [_tile_spec()] * 4,
         out_specs=[_tile_spec()] * 3 + [part_spec] * 2,
-        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3
+        out_shape=[jax.ShapeDtypeStruct(pp.shape, mp.dtype),
+                   jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(pp.shape, jnp.float32)]
         + [jax.ShapeDtypeStruct((n_tiles, _SUBS_PER_BLOCK), jnp.float32)] * 2,
         input_output_aliases={3: 0, 4: 1},
         compiler_params=_dimsem("parallel"),
@@ -359,6 +388,9 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
     row_ratio = jnp.repeat(ratio[ids], _SUB)[:, None]  # (rows, 1)
     lr_t = jnp.asarray(lr, jnp.float32)
     p_new = pp[:rows] - lr_t * row_ratio * u[:rows]
+    if emit_compute_dtype is not None:
+        return (p_new, m_new[:rows], v_new[:rows],
+                p_new.astype(emit_compute_dtype))
     return p_new, m_new[:rows], v_new[:rows]
 
 
@@ -366,7 +398,7 @@ def flat_lamb(grads: jax.Array, params: jax.Array, m: jax.Array,
 # Adagrad — ref csrc/multi_tensor_adagrad.cu
 # ---------------------------------------------------------------------------
 
-def _adagrad_kernel(sc_ref, g_ref, p_ref, s_ref, p_out, s_out):
+def _adagrad_kernel(sc_ref, g_ref, p_ref, s_ref, p_out, s_out, *pc_out):
     lr = sc_ref[0, 0]
     eps = sc_ref[0, 1]
     wd = sc_ref[0, 2]
@@ -380,18 +412,21 @@ def _adagrad_kernel(sc_ref, g_ref, p_ref, s_ref, p_out, s_out):
     g = g + (1.0 - adagrad_w) * wd * p
     s = s + g * g
     u = g / (jnp.sqrt(s) + eps) + adagrad_w * wd * p
-    p_out[:] = p - lr * u
+    p_new = p - lr * u
+    p_out[:] = p_new
     s_out[:] = s
+    if pc_out:
+        pc_out[0][:] = p_new.astype(pc_out[0].dtype)
 
 
 def flat_adagrad(grads: jax.Array, params: jax.Array, gsum: jax.Array,
                  *, lr, eps: float, weight_decay,
                  adagrad_w_mode: bool = False, grad_scale=1.0,
-                 interpret: Optional[bool] = None
-                 ) -> Tuple[jax.Array, jax.Array]:
+                 emit_compute_dtype=None,
+                 interpret: Optional[bool] = None):
     """One fused Adagrad step over flat fp32 buffers (ref:
     ``csrc/multi_tensor_adagrad.cu``); ``params``/``gsum`` alias in
-    place."""
+    place. ``emit_compute_dtype`` appends the fused cast-out output."""
     rows = params.shape[0]
     gp, pp, sp = (_pad_to_block(b) for b in (grads, params, gsum))
     n_tiles = pp.shape[0] // BLOCK_ROWS
@@ -401,24 +436,28 @@ def flat_adagrad(grads: jax.Array, params: jax.Array, gsum: jax.Array,
         jnp.float32(1.0 if adagrad_w_mode else 0.0),
         jnp.asarray(grad_scale, jnp.float32),
     ]).reshape(1, 5)
-    p_new, s_new = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2
+    if emit_compute_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct(pp.shape, emit_compute_dtype))
+    outs = pl.pallas_call(
         _adagrad_kernel,
         grid=(n_tiles,),
         in_specs=[_smem_spec()] + [_tile_spec()] * 3,
-        out_specs=[_tile_spec()] * 2,
-        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        out_specs=[_tile_spec()] * len(out_shape),
+        out_shape=out_shape,
         input_output_aliases={2: 0, 3: 1},
         compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, sp)
-    return p_new[:rows], s_new[:rows]
+    return tuple(o[:rows] for o in outs)
 
 
 # ---------------------------------------------------------------------------
 # NovoGrad — ref csrc/multi_tensor_novograd.cu (per-tensor second moment)
 # ---------------------------------------------------------------------------
 
-def _novograd_kernel(sc_ref, denom_ref, g_ref, p_ref, m_ref, p_out, m_out):
+def _novograd_kernel(sc_ref, denom_ref, g_ref, p_ref, m_ref, p_out, m_out,
+                     *pc_out):
     lr = sc_ref[0, 0]
     b1 = sc_ref[0, 1]
     beta3 = sc_ref[0, 2]       # 1-b1 (grad averaging) or 1.0
@@ -429,14 +468,17 @@ def _novograd_kernel(sc_ref, denom_ref, g_ref, p_ref, m_ref, p_out, m_out):
 
     g = g_ref[:].astype(jnp.float32) * grad_scale
     p = p_ref[:]
-    m = m_ref[:]
+    m = m_ref[:].astype(jnp.float32)   # fp32 accumulate for bf16 moments
 
     gn = g / denom_ref[:]      # per-row broadcast of the per-tensor denom
     gn = gn + reg_inside * wd * p
     m = b1 * m + beta3 * gn
     u = m / c1 + (1.0 - reg_inside) * wd * p
-    p_out[:] = p - lr * u
-    m_out[:] = m
+    p_new = p - lr * u
+    p_out[:] = p_new
+    m_out[:] = m.astype(m_out.dtype)
+    if pc_out:
+        pc_out[0][:] = p_new.astype(pc_out[0].dtype)
 
 
 def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
@@ -444,8 +486,8 @@ def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
                   eps: float, step, weight_decay, num_tensors: int,
                   grad_averaging: bool = True, bias_correction: bool = True,
                   reg_inside_moment: bool = False, init_zero: bool = False,
-                  grad_scale=1.0, interpret: Optional[bool] = None
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                  grad_scale=1.0, emit_compute_dtype=None,
+                  interpret: Optional[bool] = None):
     """Fused NovoGrad step over flat fp32 buffers. NovoGrad's second
     moment is ONE scalar per tensor (the layer-wise EMA of ||g||², ref
     ``multi_tensor_novograd.cu``), so ``v`` is a ``(num_tensors,)`` fp32
@@ -453,7 +495,9 @@ def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
     (the same two-stage reduction LAMB uses), the tiny v-EMA update is
     XLA, and the elementwise moment/param update is one Pallas pass with
     the per-tensor denominator broadcast in as a ``(rows, 1)`` column.
-    ``tile_ids`` is ``FlatSpec.tile_tensor_ids(8)``.
+    ``tile_ids`` is ``FlatSpec.tile_tensor_ids(8)``. ``m`` may be bf16
+    (fp32 accumulate); ``emit_compute_dtype`` appends the fused cast-out
+    output (return grows to ``(p, m, v, compute)``).
     """
     rows = params.shape[0]
     gs = jnp.asarray(grad_scale, jnp.float32)
@@ -488,29 +532,39 @@ def flat_novograd(grads: jax.Array, params: jax.Array, m: jax.Array,
     ]).reshape(1, 7)
     denom_spec = pl.BlockSpec((BLOCK_ROWS, 1), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
-    p_new, m_new = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+                 jax.ShapeDtypeStruct(pp.shape, mp.dtype)]
+    if emit_compute_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct(pp.shape, emit_compute_dtype))
+    outs = pl.pallas_call(
         _novograd_kernel,
         grid=(n_tiles,),
         in_specs=[_smem_spec(), denom_spec] + [_tile_spec()] * 3,
-        out_specs=[_tile_spec()] * 2,
-        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 2,
+        out_specs=[_tile_spec()] * len(out_shape),
+        out_shape=out_shape,
         input_output_aliases={3: 0, 4: 1},
         compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, row_denom, gp, pp, mp)
-    return p_new[:rows], m_new[:rows], v_new
+    if emit_compute_dtype is not None:
+        return outs[0][:rows], outs[1][:rows], v_new, outs[2][:rows]
+    return outs[0][:rows], outs[1][:rows], v_new
 
 
 def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
               *, lr, beta1: float, beta2: float, eps: float, step,
               weight_decay, adam_w_mode: bool = True,
               bias_correction: bool = True, grad_scale=1.0,
-              interpret: Optional[bool] = None
-              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One fused Adam/AdamW step over flat fp32 buffers.
+              emit_compute_dtype=None,
+              interpret: Optional[bool] = None):
+    """One fused Adam/AdamW step over flat buffers.
 
     ``params``/``m``/``v`` are aliased in place (donate them under jit).
-    All hyperparameters may be traced scalars.
+    All hyperparameters may be traced scalars. ``m`` may be bf16 (loaded
+    with an fp32 upcast, stored back in its own dtype); ``v`` must stay
+    fp32. With ``emit_compute_dtype`` the kernel writes one extra
+    (non-aliased) output — the updated params cast to that dtype — and the
+    return grows to ``(p, m, v, compute)``.
     """
     rows = params.shape[0]
     gp, pp, mp, vp = (_pad_to_block(b) for b in (grads, params, m, v))
@@ -528,14 +582,22 @@ def flat_adam(grads: jax.Array, params: jax.Array, m: jax.Array, v: jax.Array,
         jnp.float32(1.0 if adam_w_mode else 0.0),
         jnp.asarray(grad_scale, jnp.float32),
     ]).reshape(1, 9)
-    p_new, m_new, v_new = pl.pallas_call(
+    n_out = 3 + (1 if emit_compute_dtype is not None else 0)
+    out_shape = [
+        jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+        jax.ShapeDtypeStruct(pp.shape, mp.dtype),
+        jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+    ]
+    if emit_compute_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct(pp.shape, emit_compute_dtype))
+    outs = pl.pallas_call(
         _adam_kernel,
         grid=(n_tiles,),
         in_specs=[_smem_spec()] + [_tile_spec()] * 4,
-        out_specs=[_tile_spec()] * 3,
-        out_shape=[jax.ShapeDtypeStruct(pp.shape, jnp.float32)] * 3,
+        out_specs=[_tile_spec()] * n_out,
+        out_shape=out_shape,
         input_output_aliases={2: 0, 3: 1, 4: 2},
         compiler_params=_dimsem("parallel"),
         interpret=pallas_interpret(interpret),
     )(sc, gp, pp, mp, vp)
-    return p_new[:rows], m_new[:rows], v_new[:rows]
+    return tuple(o[:rows] for o in outs)

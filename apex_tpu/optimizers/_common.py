@@ -20,9 +20,26 @@ import jax.numpy as jnp
 from apex_tpu.amp.scaler import apply_if_finite
 from apex_tpu.multi_tensor_apply import multi_tensor_l2norm
 
+# dtypes accepted for reduced-precision first moments (``m_dtype``): fp32
+# is exact apex semantics; bf16 halves the moment's HBM bytes with fp32
+# accumulate inside the kernel (v always stays fp32).
+_STATE_DTYPES = (jnp.float32, jnp.bfloat16)
+
+
+def check_m_dtype(m_dtype) -> Any:
+    dt = jnp.dtype(m_dtype)
+    if not any(dt == jnp.dtype(d) for d in _STATE_DTYPES):
+        raise ValueError(
+            f"m_dtype must be float32 or bfloat16, got {dt}")
+    return dt
+
 
 def tree_zeros_f32(params: Any) -> Any:
     return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def tree_zeros(params: Any, dtype) -> Any:
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), params)
 
 
 def select_finite(found_inf: Optional[jax.Array], new: Any, old: Any) -> Any:
@@ -47,6 +64,44 @@ def tree_unzip(out: Any, n: int) -> Tuple[Any, ...]:
     return tuple(
         jax.tree.map(lambda o, i=i: o[i], out, is_leaf=is_tup)
         for i in range(n))
+
+
+def cast_like(tree: Any, template: Optional[Any],
+              default_dtype=jnp.bfloat16) -> Any:
+    """Cast each floating leaf of ``tree`` to the dtype of the matching
+    ``template`` leaf (or ``default_dtype`` when ``template`` is None) —
+    the tree-path compute-param emission. XLA fuses these casts into the
+    kernel that produced ``tree``, so emission costs one extra low-
+    precision write, not a separate read-the-master pass."""
+    if template is None:
+        return jax.tree.map(
+            lambda x: x.astype(default_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+    return jax.tree.map(
+        lambda x, t: x.astype(t.dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree, template)
+
+
+def finish_compute_params(new_params: Any, params: Any,
+                          compute_params: Optional[Any],
+                          found_inf: Optional[jax.Array],
+                          precomputed: Optional[Any] = None) -> Any:
+    """Shared tail of every optimizer's ``emit_compute_params`` path.
+
+    ``precomputed`` is the kernel-emitted compute tree (flat paths);
+    the tree paths leave it None and cast ``new_params`` per-leaf.
+    ``compute_params`` (the previous compute tree) supplies the target
+    dtypes and the cheap old value for the overflow-skip select; without
+    it the skip falls back to re-casting the old master (correct, but
+    pays the cast the fused path exists to avoid — pass it when using
+    dynamic loss scaling)."""
+    new_c = precomputed if precomputed is not None else \
+        cast_like(new_params, compute_params)
+    if found_inf is None:
+        return new_c
+    old_c = compute_params if compute_params is not None else \
+        cast_like(params, None)
+    return apply_if_finite(new_c, old_c, found_inf)
 
 
 def flat_layout(cache: dict, params: Any):

@@ -22,8 +22,8 @@ import jax.numpy as jnp
 from apex_tpu.multi_tensor_apply import flatten as _flatten
 from apex_tpu.multi_tensor_apply import kernels as _kernels
 from apex_tpu.optimizers._common import (
-    flat_layout,
-    f32, select_finite, tree_unzip, tree_zeros_f32,
+    check_m_dtype, finish_compute_params, flat_layout,
+    f32, select_finite, tree_unzip, tree_zeros,
 )
 
 
@@ -37,7 +37,8 @@ class FusedAdam:
     def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
-                 amsgrad: bool = False, *, use_flat_kernel: bool = False):
+                 amsgrad: bool = False, *, use_flat_kernel: bool = False,
+                 m_dtype=jnp.float32, emit_compute_params: bool = False):
         if amsgrad:
             # matches the reference: FusedAdam raises on amsgrad
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
@@ -48,6 +49,12 @@ class FusedAdam:
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
         self.use_flat_kernel = use_flat_kernel
+        # reduced-precision first moment (fp32 accumulate, v stays fp32)
+        self.m_dtype = check_m_dtype(m_dtype)
+        # fused cast-out: step additionally returns the updated params
+        # pre-cast to the compute dtypes (amp-O2 skips model_params_
+        # from_master); see _common.finish_compute_params
+        self.emit_compute_params = emit_compute_params
         # layout cache keyed by treedef: one optimizer instance may serve
         # several param trees (init called more than once)
         self._specs = {}
@@ -56,16 +63,16 @@ class FusedAdam:
         step = jnp.zeros((), jnp.int32)
         if self.use_flat_kernel:
             leaves, _, spec, _ = flat_layout(self._specs, params)
-            buf, _ = _flatten.flatten_tensors(leaves, spec)
-            return AdamState(step=step, m=jnp.zeros_like(buf),
-                             v=jnp.zeros_like(buf))
-        return AdamState(step=step, m=tree_zeros_f32(params),
-                         v=tree_zeros_f32(params))
+            return AdamState(step=step,
+                             m=_flatten.zeros_buffer(spec, self.m_dtype),
+                             v=_flatten.zeros_buffer(spec, jnp.float32))
+        return AdamState(step=step, m=tree_zeros(params, self.m_dtype),
+                         v=tree_zeros(params, jnp.float32))
 
     def step(self, grads: Any, params: Any, state: AdamState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
-             found_inf: Optional[jax.Array] = None
-             ) -> Tuple[Any, AdamState]:
+             found_inf: Optional[jax.Array] = None,
+             compute_params: Optional[Any] = None):
         """One optimizer step.
 
         ``grad_scale`` MULTIPLIES the gradients (it is the combined
@@ -75,6 +82,12 @@ class FusedAdam:
         is uniform across every ``apex_tpu.optimizers`` step and the flat
         Pallas kernel (``kernels.flat_adam``), chosen so the unscale
         fuses into the update as a multiply without a reciprocal op.
+
+        With ``emit_compute_params`` the return grows to ``(params,
+        state, compute)`` where ``compute`` is the updated params cast to
+        the dtypes of ``compute_params`` (the previous compute tree —
+        pass it; it also provides the cheap overflow-skip fallback) or
+        uniformly bf16 when ``compute_params`` is None.
         """
         lr = f32(self.lr if lr is None else lr)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
@@ -82,17 +95,30 @@ class FusedAdam:
 
         with jax.named_scope("FusedAdam.step"):
             if self.use_flat_kernel:
-                new_params, new_state = self._flat_step(
+                new_params, new_state, pc = self._flat_step(
                     grads, params, state, lr, wd, t, grad_scale)
             else:
                 new_params, new_state = self._tree_step(
                     grads, params, state, lr, wd, t, grad_scale)
+                pc = None
 
         # On overflow the reference skips optimizer.step() entirely, so
         # params AND optimizer state (including the step count) stay put.
         new_params = select_finite(found_inf, new_params, params)
         new_state = select_finite(found_inf, new_state, state)
-        return new_params, new_state
+        if not self.emit_compute_params:
+            return new_params, new_state
+        if pc is not None and compute_params is not None:
+            # kernel emits uniform bf16; leaves whose compute dtype
+            # differs (e.g. keep-fp32 norms) re-cast from the (selected)
+            # master — those leaves are the small minority by bytes
+            pc = jax.tree.map(
+                lambda c, tmpl, p: c if c.dtype == tmpl.dtype
+                else p.astype(tmpl.dtype),
+                pc, compute_params, new_params)
+        compute = finish_compute_params(new_params, params, compute_params,
+                                        found_inf, precomputed=pc)
+        return new_params, new_state, compute
 
     # -- paths ----------------------------------------------------------
     def _tree_step(self, grads, params, state, lr, wd, t, grad_scale):
@@ -106,17 +132,19 @@ class FusedAdam:
             c1 = c2 = jnp.float32(1.0)
         aw = self.adam_w_mode
 
+        md = self.m_dtype
+
         def upd(g, p, m, v):
             g = g.astype(jnp.float32) * gs
             p32 = p.astype(jnp.float32)
             if not aw:
                 g = g + wd * p32
-            m = b1 * m + (1.0 - b1) * g
+            m = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
             v = b2 * v + (1.0 - b2) * g * g
             u = (m / c1) / (jnp.sqrt(v / c2) + eps)
             if aw:
                 u = u + wd * p32
-            return (p32 - lr * u).astype(p.dtype), m, v
+            return (p32 - lr * u).astype(p.dtype), m.astype(md), v
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
         new_params, new_m, new_v = tree_unzip(out, 3)
@@ -127,11 +155,19 @@ class FusedAdam:
         gbuf, _ = _flatten.flatten_tensors(
             jax.tree_util.tree_leaves(grads), spec)
         pbuf, _ = _flatten.flatten_tensors(leaves, spec)
-        p_new, m_new, v_new = _kernels.flat_adam(
+        emit_dt = jnp.bfloat16 if self.emit_compute_params else None
+        outs = _kernels.flat_adam(
             gbuf, pbuf, state.m, state.v,
             lr=lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
             step=t, weight_decay=wd, adam_w_mode=self.adam_w_mode,
-            bias_correction=self.bias_correction, grad_scale=grad_scale)
+            bias_correction=self.bias_correction, grad_scale=grad_scale,
+            emit_compute_dtype=emit_dt)
+        p_new, m_new, v_new = outs[:3]
         new_params = jax.tree_util.tree_unflatten(
             treedef, _flatten.unflatten_tensors(p_new, spec))
-        return new_params, AdamState(step=t, m=m_new, v=v_new)
+        pc = None
+        if emit_dt is not None:
+            pc = jax.tree_util.tree_unflatten(
+                treedef,
+                _flatten.unflatten_tensors(outs[3], spec, cast_back=False))
+        return new_params, AdamState(step=t, m=m_new, v=v_new), pc

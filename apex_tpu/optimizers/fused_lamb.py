@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers._common import (
-    f32, global_grad_norm, select_finite, tree_unzip, tree_zeros_f32,
+    check_m_dtype, f32, finish_compute_params, global_grad_norm,
+    select_finite, tree_unzip, tree_zeros, tree_zeros_f32,
 )
 
 
@@ -34,9 +35,12 @@ class FusedLAMB:
                  adam_w_mode: bool = True, grad_averaging: bool = True,
                  max_grad_norm: float = 1.0,
                  use_nvlamb: bool = False, *,
-                 use_flat_kernel: bool = False):
+                 use_flat_kernel: bool = False,
+                 m_dtype=jnp.float32, emit_compute_params: bool = False):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        self.m_dtype = check_m_dtype(m_dtype)
+        self.emit_compute_params = emit_compute_params
         self.lr = lr
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
@@ -61,21 +65,23 @@ class FusedLAMB:
             from apex_tpu.multi_tensor_apply import flatten as _flatten
 
             leaves, _, spec, _ = self._layout(params)
-            buf, _ = _flatten.flatten_tensors(leaves, spec,
-                                              dtype=jnp.float32)
-            return LambState(step=step, m=jnp.zeros_like(buf),
-                             v=jnp.zeros_like(buf))
+            return LambState(step=step,
+                             m=_flatten.zeros_buffer(spec, self.m_dtype),
+                             v=_flatten.zeros_buffer(spec, jnp.float32))
         return LambState(step=step,
-                         m=tree_zeros_f32(params), v=tree_zeros_f32(params))
+                         m=tree_zeros(params, self.m_dtype),
+                         v=tree_zeros_f32(params))
 
     def step(self, grads: Any, params: Any, state: LambState, *,
              lr=None, weight_decay=None, grad_scale=1.0,
              grad_norm: Optional[jax.Array] = None,
-             found_inf: Optional[jax.Array] = None
-             ) -> Tuple[Any, LambState]:
+             found_inf: Optional[jax.Array] = None,
+             compute_params: Optional[Any] = None):
         """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
         scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
-        DIVIDES — invert when porting. See ``FusedAdam.step``."""
+        DIVIDES — invert when porting. With ``emit_compute_params`` the
+        return grows to ``(params, state, compute)``. See
+        ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
         gs = f32(grad_scale)
@@ -97,7 +103,8 @@ class FusedLAMB:
             gbuf, _ = _flatten.flatten_tensors(
                 jax.tree_util.tree_leaves(grads), spec)
             pbuf, _ = _flatten.flatten_tensors(leaves, spec)
-            p_new, m_new, v_new = flat_lamb(
+            emit_dt = jnp.bfloat16 if self.emit_compute_params else None
+            outs = flat_lamb(
                 gbuf, pbuf, state.m, state.v, tile_ids,
                 lr=lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
                 step=t, weight_decay=wd, num_tensors=spec.num_tensors,
@@ -106,13 +113,27 @@ class FusedLAMB:
                 bias_correction=self.bias_correction,
                 use_nvlamb=self.use_nvlamb,
                 max_grad_norm=self.max_grad_norm, grad_scale=gs,
-                grad_norm=grad_norm)
+                grad_norm=grad_norm, emit_compute_dtype=emit_dt)
+            p_new, m_new, v_new = outs[:3]
             new_params = jax.tree_util.tree_unflatten(
                 treedef, _flatten.unflatten_tensors(p_new, spec))
             new_state = LambState(step=t, m=m_new, v=v_new)
             new_params = select_finite(found_inf, new_params, params)
             new_state = select_finite(found_inf, new_state, state)
-            return new_params, new_state
+            if not self.emit_compute_params:
+                return new_params, new_state
+            pc = jax.tree_util.tree_unflatten(
+                treedef,
+                _flatten.unflatten_tensors(outs[3], spec, cast_back=False))
+            if compute_params is not None:
+                pc = jax.tree.map(
+                    lambda c, tmpl, p: c if c.dtype == tmpl.dtype
+                    else p.astype(tmpl.dtype),
+                    pc, compute_params, new_params)
+            compute = finish_compute_params(
+                new_params, params, compute_params, found_inf,
+                precomputed=pc)
+            return new_params, new_state, compute
 
         # stage 1 preamble: global-norm grad clipping
         if grad_norm is None:
@@ -128,7 +149,7 @@ class FusedLAMB:
             p32 = p.astype(jnp.float32)
             if not self.adam_w_mode:
                 g = g + wd * p32
-            m = b1 * m + beta3 * g
+            m = b1 * m.astype(jnp.float32) + beta3 * g
             v = b2 * v + (1.0 - b2) * g * g
             u = (m / c1) / (jnp.sqrt(v / c2) + eps)
             if self.adam_w_mode:
@@ -143,7 +164,8 @@ class FusedLAMB:
                 # skip the trust-ratio (decoupled_wd group split); wd is a
                 # scalar here so the split reduces to this where().
                 ratio = jnp.where(wd == 0.0, jnp.float32(1.0), ratio)
-            return (p32 - lr * ratio * u).astype(p.dtype), m, v
+            return ((p32 - lr * ratio * u).astype(p.dtype),
+                    m.astype(self.m_dtype), v)
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
         new_params, new_m, new_v = tree_unzip(out, 3)
@@ -151,4 +173,8 @@ class FusedLAMB:
 
         new_params = select_finite(found_inf, new_params, params)
         new_state = select_finite(found_inf, new_state, state)
-        return new_params, new_state
+        if not self.emit_compute_params:
+            return new_params, new_state
+        compute = finish_compute_params(new_params, params, compute_params,
+                                        found_inf)
+        return new_params, new_state, compute

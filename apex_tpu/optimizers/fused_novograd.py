@@ -21,8 +21,8 @@ import jax.numpy as jnp
 from apex_tpu.multi_tensor_apply import flatten as _flatten
 from apex_tpu.multi_tensor_apply import kernels as _kernels
 from apex_tpu.optimizers._common import (
-    flat_layout,
-    f32, select_finite, tree_unzip, tree_zeros_f32,
+    check_m_dtype, finish_compute_params, flat_layout,
+    f32, select_finite, tree_unzip, tree_zeros,
 )
 
 
@@ -39,7 +39,10 @@ class FusedNovoGrad:
                  reg_inside_moment: bool = False, grad_averaging: bool = True,
                  norm_type: int = 2, init_zero: bool = False,
                  bias_correction: bool = True, *,
-                 use_flat_kernel: bool = False):
+                 use_flat_kernel: bool = False,
+                 m_dtype=jnp.float32, emit_compute_params: bool = False):
+        self.m_dtype = check_m_dtype(m_dtype)
+        self.emit_compute_params = emit_compute_params
         if amsgrad:
             raise RuntimeError(
                 "FusedNovoGrad does not support the AMSGrad variant.")
@@ -60,22 +63,23 @@ class FusedNovoGrad:
         step = jnp.zeros((), jnp.int32)
         if self.use_flat_kernel:
             leaves, _, spec, _ = flat_layout(self._specs, params)
-            buf, _ = _flatten.flatten_tensors(leaves, spec)
             return NovoGradState(
-                step=step, m=jnp.zeros_like(buf),
+                step=step, m=_flatten.zeros_buffer(spec, self.m_dtype),
                 v=jnp.zeros((spec.num_tensors,), jnp.float32))
         return NovoGradState(
             step=step,
-            m=tree_zeros_f32(params),
+            m=tree_zeros(params, self.m_dtype),
             v=jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params))
 
     def step(self, grads: Any, params: Any, state: NovoGradState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
-             found_inf: Optional[jax.Array] = None
-             ) -> Tuple[Any, NovoGradState]:
+             found_inf: Optional[jax.Array] = None,
+             compute_params: Optional[Any] = None):
         """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
         scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
-        DIVIDES — invert when porting. See ``FusedAdam.step``."""
+        DIVIDES — invert when porting. With ``emit_compute_params`` the
+        return grows to ``(params, state, compute)``. See
+        ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
         wd = f32(self.weight_decay if weight_decay is None else weight_decay)
@@ -87,7 +91,8 @@ class FusedNovoGrad:
             gbuf, _ = _flatten.flatten_tensors(
                 jax.tree_util.tree_leaves(grads), spec)
             pbuf, _ = _flatten.flatten_tensors(leaves, spec)
-            p_new, m_new, v_new = _kernels.flat_novograd(
+            emit_dt = jnp.bfloat16 if self.emit_compute_params else None
+            outs = _kernels.flat_novograd(
                 gbuf, pbuf, state.m, state.v,
                 tile_ids, lr=lr, beta1=self.beta1,
                 beta2=self.beta2, eps=self.eps, step=t, weight_decay=wd,
@@ -95,13 +100,28 @@ class FusedNovoGrad:
                 grad_averaging=self.grad_averaging,
                 bias_correction=self.bias_correction,
                 reg_inside_moment=self.reg_inside_moment,
-                init_zero=self.init_zero, grad_scale=gs)
+                init_zero=self.init_zero, grad_scale=gs,
+                emit_compute_dtype=emit_dt)
+            p_new, m_new, v_new = outs[:3]
             new_params = jax.tree_util.tree_unflatten(
                 treedef, _flatten.unflatten_tensors(p_new, spec))
             new_state = NovoGradState(step=t, m=m_new, v=v_new)
             new_params = select_finite(found_inf, new_params, params)
             new_state = select_finite(found_inf, new_state, state)
-            return new_params, new_state
+            if not self.emit_compute_params:
+                return new_params, new_state
+            pc = jax.tree_util.tree_unflatten(
+                treedef,
+                _flatten.unflatten_tensors(outs[3], spec, cast_back=False))
+            if compute_params is not None:
+                pc = jax.tree.map(
+                    lambda c, tmpl, p: c if c.dtype == tmpl.dtype
+                    else p.astype(tmpl.dtype),
+                    pc, compute_params, new_params)
+            compute = finish_compute_params(
+                new_params, params, compute_params, found_inf,
+                precomputed=pc)
+            return new_params, new_state, compute
 
         b1, b2, eps = f32(self.beta1), f32(self.beta2), f32(self.eps)
         tf = t.astype(jnp.float32)
@@ -125,11 +145,11 @@ class FusedNovoGrad:
             gn = g / denom
             if self.reg_inside_moment:
                 gn = gn + wd * p32
-            m = b1 * m + beta3 * gn
+            m = b1 * m.astype(jnp.float32) + beta3 * gn
             u = m / c1
             if not self.reg_inside_moment:
                 u = u + wd * p32
-            return (p32 - lr * u).astype(p.dtype), m, v
+            return (p32 - lr * u).astype(p.dtype), m.astype(self.m_dtype), v
 
         out = jax.tree.map(upd, grads, params, state.m, state.v)
         new_params, new_m, new_v = tree_unzip(out, 3)
@@ -137,4 +157,8 @@ class FusedNovoGrad:
 
         new_params = select_finite(found_inf, new_params, params)
         new_state = select_finite(found_inf, new_state, state)
-        return new_params, new_state
+        if not self.emit_compute_params:
+            return new_params, new_state
+        compute = finish_compute_params(new_params, params, compute_params,
+                                        found_inf)
+        return new_params, new_state, compute

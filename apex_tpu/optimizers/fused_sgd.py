@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers._common import (
-    f32, select_finite, tree_unzip, tree_zeros_f32,
+    check_m_dtype, f32, finish_compute_params, select_finite, tree_unzip,
+    tree_zeros,
 )
 
 
@@ -25,10 +26,14 @@ class FusedSGD:
                  dampening: float = 0.0, weight_decay: float = 0.0,
                  nesterov: bool = False, *,
                  wd_after_momentum: bool = False,
-                 use_flat_kernel: bool = False):
+                 use_flat_kernel: bool = False,
+                 m_dtype=jnp.float32, emit_compute_params: bool = False):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
+        # ``m`` here is the momentum buffer (SGD's only moment)
+        self.m_dtype = check_m_dtype(m_dtype)
+        self.emit_compute_params = emit_compute_params
         self.lr = lr
         self.momentum = momentum
         self.dampening = dampening
@@ -50,18 +55,21 @@ class FusedSGD:
             from apex_tpu.multi_tensor_apply import flatten as _flatten
 
             leaves, _, spec = self._layout(params)
-            buf, _ = _flatten.flatten_tensors(leaves, spec,
-                                              dtype=jnp.float32)
-            return SGDState(step=step, momentum_buf=jnp.zeros_like(buf))
-        return SGDState(step=step, momentum_buf=tree_zeros_f32(params))
+            return SGDState(
+                step=step,
+                momentum_buf=_flatten.zeros_buffer(spec, self.m_dtype))
+        return SGDState(step=step,
+                        momentum_buf=tree_zeros(params, self.m_dtype))
 
     def step(self, grads: Any, params: Any, state: SGDState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
-             found_inf: Optional[jax.Array] = None
-             ) -> Tuple[Any, SGDState]:
+             found_inf: Optional[jax.Array] = None,
+             compute_params: Optional[Any] = None):
         """``grad_scale`` MULTIPLIES the gradients (combined inverse loss
         scale: pass ``1 / loss_scale``); the reference's ``scale`` arg
-        DIVIDES — invert when porting. See ``FusedAdam.step``."""
+        DIVIDES — invert when porting. With ``emit_compute_params`` the
+        return grows to ``(params, state, compute)``. See
+        ``FusedAdam.step``."""
         lr = f32(self.lr if lr is None else lr)
         gs = f32(grad_scale)
         mom, damp = f32(self.momentum), f32(self.dampening)
@@ -77,18 +85,33 @@ class FusedSGD:
             gbuf, _ = _flatten.flatten_tensors(
                 jax.tree_util.tree_leaves(grads), spec)
             pbuf, _ = _flatten.flatten_tensors(leaves, spec)
-            p_new, b_new = flat_sgd(
+            emit_dt = jnp.bfloat16 if self.emit_compute_params else None
+            outs = flat_sgd(
                 gbuf, pbuf, state.momentum_buf, lr=lr,
                 momentum=self.momentum, dampening=self.dampening,
                 weight_decay=wd, nesterov=self.nesterov,
                 wd_after_momentum=self.wd_after_momentum,
-                first_run=first, grad_scale=gs)
+                first_run=first, grad_scale=gs, emit_compute_dtype=emit_dt)
+            p_new, b_new = outs[:2]
             new_params = jax.tree_util.tree_unflatten(
                 treedef, _flatten.unflatten_tensors(p_new, spec))
             new_state = SGDState(step=t, momentum_buf=b_new)
             new_params = select_finite(found_inf, new_params, params)
             new_state = select_finite(found_inf, new_state, state)
-            return new_params, new_state
+            if not self.emit_compute_params:
+                return new_params, new_state
+            pc = jax.tree_util.tree_unflatten(
+                treedef,
+                _flatten.unflatten_tensors(outs[2], spec, cast_back=False))
+            if compute_params is not None:
+                pc = jax.tree.map(
+                    lambda c, tmpl, p: c if c.dtype == tmpl.dtype
+                    else p.astype(tmpl.dtype),
+                    pc, compute_params, new_params)
+            compute = finish_compute_params(
+                new_params, params, compute_params, found_inf,
+                precomputed=pc)
+            return new_params, new_state, compute
 
         def upd(g, p, buf):
             g = g.astype(jnp.float32) * gs
@@ -96,9 +119,11 @@ class FusedSGD:
             if not self.wd_after_momentum:
                 g = g + wd * p32
             if self.momentum > 0:
-                seeded = jnp.where(first, g, mom * buf + (1.0 - damp) * g)
+                seeded = jnp.where(first, g,
+                                   mom * buf.astype(jnp.float32)
+                                   + (1.0 - damp) * g)
                 d = g + mom * seeded if self.nesterov else seeded
-                buf = seeded
+                buf = seeded.astype(self.m_dtype)
             else:
                 d = g
             if self.wd_after_momentum:
@@ -111,4 +136,8 @@ class FusedSGD:
 
         new_params = select_finite(found_inf, new_params, params)
         new_state = select_finite(found_inf, new_state, state)
-        return new_params, new_state
+        if not self.emit_compute_params:
+            return new_params, new_state
+        compute = finish_compute_params(new_params, params, compute_params,
+                                        found_inf)
+        return new_params, new_state, compute

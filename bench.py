@@ -376,32 +376,53 @@ def bench_flat_vs_tree_many_tensors(on_tpu):
 
 # -- shared BERT train-step builder ----------------------------------------
 
-def _bert_step(batch, seq, cfg):
+def _bert_step(batch, seq, cfg, m_dtype=jnp.float32, emit_compute=False):
     """Returns (train_step, make_state, (ids, mask)); ``make_state`` is
-    a zero-arg factory so the donating timer holds ONE state copy."""
+    a zero-arg factory so the donating timer holds ONE state copy.
+
+    ``m_dtype``/``emit_compute`` are the reduced-precision state levers:
+    bf16 Adam first moment, and the fused bf16 cast-out carried in the
+    loop state and consumed via ``cast_model(precast=...)`` — the O2
+    per-step fp32->bf16 master re-cast disappears. With ``emit_compute``
+    the state/step grow a 4th ``compute`` slot."""
     from apex_tpu import amp
     from apex_tpu.models import apply_bert, init_bert, mlm_loss
     from apex_tpu.optimizers import FusedAdam
 
     h = amp.initialize(opt_level="O2", loss_scale="dynamic")
-    opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+    opt = FusedAdam(lr=1e-4, weight_decay=0.01, m_dtype=m_dtype,
+                    emit_compute_params=emit_compute)
 
     def make_state():
         params = init_bert(jax.random.PRNGKey(0), cfg)
-        return params, opt.init(params), h.init_state()
+        base = (params, opt.init(params), h.init_state())
+        if not emit_compute:
+            return base
+        # copy: outside jit the keep-fp32 norm leaves of cast_model come
+        # back as the SAME arrays as params — the donating timer would
+        # see one buffer donated twice
+        compute = jax.tree.map(jnp.copy, h.cast_model(params))
+        return base + (compute,)
 
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                              cfg.vocab_size)
     mask = jnp.ones((batch, seq), jnp.int32)
 
-    def train_step(master, opt_state, scaler_state, ids, mask):
+    def train_step(master, opt_state, scaler_state, *rest):
+        *compute, ids, mask = rest
+
         def loss_fn(p):
             out = apply_bert(p, cfg, ids, mask)
             return mlm_loss(out["mlm_logits"], ids, mask)
 
-        p = h.cast_model(master)
+        p = h.cast_model(master, precast=compute[0] if compute else None)
         loss, grads, found_inf, scaler_state = h.value_and_grad(loss_fn)(
             p, scaler_state)
+        if emit_compute:
+            master, opt_state, c = opt.step(
+                grads, master, opt_state, found_inf=found_inf,
+                compute_params=p)
+            return master, opt_state, scaler_state, c, loss
         master, opt_state = opt.step(grads, master, opt_state,
                                      found_inf=found_inf)
         return master, opt_state, scaler_state, loss
@@ -712,8 +733,129 @@ def _ln_ab_pair(on_tpu):
             _ab_side(make_body(ln_ref), dy0, fetch, M))
 
 
+def _adam_state_params(on_tpu):
+    """Synthetic Adam working set: ~64M params on TPU (16 x 2048^2 —
+    big enough that the step is HBM-bound, small enough that two
+    optimizer states never coexist across ab sides' builds)."""
+    n, dim = (16, 2048) if on_tpu else (4, 128)
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    params = {f"t{i}": jax.random.normal(k, (dim, dim)) for i, k in
+              enumerate(keys)}
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), params)
+    return params, grads
+
+
+def _adam_m_bf16_ab_pair(on_tpu):
+    """bf16 vs fp32 first moment on the flat Adam kernel: the m
+    read+write drops from 8 to 4 bytes/element, ~1/6 of the kernel's
+    HBM traffic (g+p+m+v in, p+m+v out)."""
+    from apex_tpu.optimizers import FusedAdam
+
+    params, grads = _adam_state_params(on_tpu)
+    M = 20 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[0]["t0"])  # noqa: E731
+    sides = []
+    for m_dtype in (jnp.bfloat16, jnp.float32):
+        opt = FusedAdam(lr=1e-4, weight_decay=0.01, use_flat_kernel=True,
+                        m_dtype=m_dtype)
+
+        def body(state, opt=opt):
+            p, s = state
+            return opt.step(grads, p, s)
+
+        sides.append(_ab_side(body, (params, opt.init(params)), fetch, M))
+    return tuple(sides)
+
+
+def _adam_castout_ab_pair(on_tpu):
+    """Fused bf16 cast-out vs the separate ``model_params_from_master``
+    pass: both sides produce (params, state, bf16 compute tree) per
+    step; side B pays an extra fp32 read of the whole master tree."""
+    from apex_tpu.amp import policy
+    from apex_tpu.optimizers import FusedAdam
+
+    params, grads = _adam_state_params(on_tpu)
+    compute = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    M = 20 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[2]["t0"].astype(jnp.float32))  # noqa: E731
+
+    opt_a = FusedAdam(lr=1e-4, weight_decay=0.01,
+                      emit_compute_params=True)
+
+    def body_a(state):
+        p, s, c = state
+        return opt_a.step(grads, p, s, compute_params=c)
+
+    opt_b = FusedAdam(lr=1e-4, weight_decay=0.01)
+
+    def body_b(state):
+        p, s, c = state
+        p, s = opt_b.step(grads, p, s)
+        return p, s, policy.model_params_from_master(p, c)
+
+    return (_ab_side(body_a, (params, opt_a.init(params), compute),
+                     fetch, M),
+            _ab_side(body_b, (params, opt_b.init(params), compute),
+                     fetch, M))
+
+
+def _small_tensor_pollution_pair(on_tpu):
+    """SEQUENTIAL instrument for the small-tensor Adam driver drift
+    (0.94 -> 1.35 -> 1.43 ms over r3-r5): measure the
+    fused_adam_tree_1024_small_tensors body in a FRESH process regime
+    (side A), then replay the process-global state the driver builds up
+    before that metric runs — the headline train-step compile+run and a
+    batch of kernel-parity style compilations — and measure again (side
+    B). Interleaved ab can't isolate this (pollution is irreversible),
+    so the entry is flagged "sequential" and returns (side_a,
+    make_side_b); bench_ab drains A before building B."""
+    import dataclasses
+
+    from apex_tpu.models import bert_large, bert_tiny
+    from apex_tpu.optimizers import FusedAdam
+
+    n = 1024 if on_tpu else 32
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    params = {f"t{i}": jax.random.normal(k, (64, 128)) for i, k in
+              enumerate(keys)}
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), params)
+    M = 20 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[0]["t0"])  # noqa: E731
+
+    def make_side():
+        opt = FusedAdam(lr=1e-4, weight_decay=0.01)
+
+        def body(state, opt=opt):
+            p, s = state
+            return opt.step(grads, p, s)
+
+        return _ab_side(body, (params, opt.init(params)), fetch, M)
+
+    def pollute():
+        # the two configs that precede the small-tensor metric in the
+        # driver's ORDER, run silently (no emit — these throwaway
+        # numbers must not enter the metric record)
+        cfg = bert_large() if on_tpu else bert_tiny()
+        cfg = dataclasses.replace(cfg, remat=False)
+        batch, seq = (64, 128) if on_tpu else (2, 64)
+        train_step, make_state, (ids, mask) = _bert_step(batch, seq, cfg)
+        st = jax.jit(train_step)(*make_state(), ids, mask)
+        jax.block_until_ready(st[-1])
+        del st
+        bench_kernel_parity(on_tpu, quiet=True)
+
+    def make_side_b():
+        pollute()
+        return make_side()
+
+    return make_side(), make_side_b
+
+
 # name -> (label_a, label_b, builder(on_tpu) -> (side_a, side_b)).
 # ratio < 1 means A (the shipped configuration) wins.
+# A 4th element "sequential" marks order-dependent pairs: the builder
+# returns (side_a, make_side_b) and bench_ab drains every A sample
+# BEFORE building B (whose build irreversibly mutates process state).
 AB_PAIRS = {
     "flash_d64_exp2": (
         "exp2", "exp",
@@ -727,22 +869,51 @@ AB_PAIRS = {
     "ln_h1024": (
         "fused_kernel", "jnp_ref",
         lambda on_tpu: _ln_ab_pair(on_tpu)),
+    "adam_m_bf16": (
+        "m_bf16", "m_fp32",
+        _adam_m_bf16_ab_pair),
+    "adam_castout": (
+        "fused_castout", "separate_cast",
+        _adam_castout_ab_pair),
+    "adam_small_tensors_pollution": (
+        "fresh", "polluted",
+        _small_tensor_pollution_pair, "sequential"),
 }
 
 
 def bench_ab(on_tpu, names=None):
     """Run the A/B pairs registry; one JSON line per pair. Driver config
-    name: ``ab_kernels``. CLI: ``python bench.py ab [pair ...]``."""
+    name: ``ab_kernels``. CLI: ``python bench.py ab [pair ...]``.
+
+    "sequential" entries (order-dependent process state) drain all A
+    samples, then call the builder's second return (a thunk whose build
+    mutates the process) and drain B — the per-round pairing survives,
+    but A/B no longer share a drift regime, which is the point."""
     for name in (names or AB_PAIRS):
         if name not in AB_PAIRS:
             print(json.dumps({"metric": f"ab_{name}",
                               "error": "unknown ab pair"}), flush=True)
             continue
-        label_a, label_b, build = AB_PAIRS[name]
+        entry = AB_PAIRS[name]
+        label_a, label_b, build = entry[:3]
+        sequential = len(entry) > 3 and entry[3] == "sequential"
         try:
-            side_a, side_b = build(on_tpu)
-            a_med, b_med, r_med, r_lo, r_hi = ab_timed(
-                side_a, side_b, rounds=5 if on_tpu else 2)
+            rounds = 5 if on_tpu else 2
+            if sequential:
+                side_a, make_side_b = build(on_tpu)
+                a_samples = [side_a() for _ in range(rounds)]
+                side_b = make_side_b()
+                b_samples = [side_b() for _ in range(rounds)]
+                pairs = list(zip(a_samples, b_samples))
+                ratios = sorted(a / b for a, b in pairs)
+                a_med = statistics.median(a_samples)
+                b_med = statistics.median(b_samples)
+                r_med, r_lo, r_hi = (statistics.median(ratios),
+                                     ratios[0], ratios[-1])
+            else:
+                side_a, side_b = build(on_tpu)
+                a_med, b_med, r_med, r_lo, r_hi = ab_timed(
+                    side_a, side_b, rounds=rounds)
         except Exception as e:
             print(json.dumps({"metric": f"ab_{name}",
                               "error": repr(e)[:200]}), flush=True)
@@ -775,34 +946,45 @@ def bench_headline(on_tpu):
     # train-state copies). Driver mode runs ONLY the winner so the
     # headline always lands inside the budget; re-tune candidates at
     # build time with BENCH_SWEEP=1.
+    # every (batch, remat) config now races the optimizer-state modes:
+    # "fp32" (legacy) vs "bf16m_castout" (bf16 first moment + fused
+    # cast-out consumed by cast_model(precast=...) — the HBM-traffic
+    # levers of this round). Driver mode runs both at the winning batch
+    # and KEEPS the better one; the loser is printed as a sweep line so
+    # a dead end still lands in the record.
+    modes = [("fp32", {}),
+             ("bf16m_castout", dict(m_dtype=jnp.bfloat16,
+                                    emit_compute=True))]
     if not on_tpu:
         configs = [(2, False)]
     elif _SWEEP:
         configs = [(48, False), (64, False), (96, False)]
     else:
         configs = [(64, False)]
+    configs = [(b, r, mode) for b, r in configs for mode in modes]
     best = None
     train_step = state = init = None
     metric = ("bert_large_pretrain_step_amp_O2_fused_adam"
               if on_tpu else "bert_tiny_cpu_smoke")
     extra = {}
-    for batch, remat in configs:
+    for batch, remat, (mode_name, mode_kw) in configs:
         # release the previous config's closures before building the
         # next (the donating timer holds only one live train state)
         train_step = state = init = None
         cfg = dataclasses.replace(base, remat=remat)
-        train_step, make_state, (ids, mask) = _bert_step(batch, seq, cfg)
+        train_step, make_state, (ids, mask) = _bert_step(batch, seq, cfg,
+                                                         **mode_kw)
 
         def body(st, train_step=train_step, ids=ids, mask=mask):
-            m, o, sc, loss = train_step(st[0], st[1], st[2], ids, mask)
-            return (m, o, sc, loss)
+            out = train_step(*st[:-1], ids, mask)
+            return out  # (..., loss) — same arity as the state tuple
 
         def init(make_state=make_state):
             return (*make_state(), jnp.float32(0))
 
         try:
-            dt = timed(body, init, lambda s: s[3], M=10 if on_tpu else 2,
-                       K=5, donate=True)
+            dt = timed(body, init, lambda s: s[-1],
+                       M=10 if on_tpu else 2, K=5, donate=True)
             # sanity gate on the CONTRACT metric: >3x off the LAST
             # driver-recorded throughput -> measure once more, keep the
             # better run (relay damage only subtracts throughput).
@@ -817,40 +999,47 @@ def bench_headline(on_tpu):
             if prior and not _SWEEP and on_tpu:
                 if not (1 / 3.0 < (batch / dt) / prior[-1] < 3.0):
                     first = batch / dt
-                    dt = min(dt, timed(body, init, lambda s: s[3],
+                    dt = min(dt, timed(body, init, lambda s: s[-1],
                                        M=10, K=5, donate=True))
                     extra = {"retried": True, "first": round(first, 2)}
         except Exception as e:  # OOM at a candidate config: skip it
-            print(json.dumps({"metric": f"headline_b{batch}_remat{remat}",
-                              "error": repr(e)[:160]}), flush=True)
+            print(json.dumps(
+                {"metric": f"headline_b{batch}_remat{remat}_{mode_name}",
+                 "error": repr(e)[:160]}), flush=True)
             continue
         sps = batch / dt
-        if _SWEEP:
-            print(json.dumps({"metric": f"headline_b{batch}_remat{remat}",
-                              "sweep_samples_per_sec": round(sps, 2),
-                              "step_ms": round(dt * 1e3, 2)}), flush=True)
+        # per-mode line ALWAYS printed (not only under _SWEEP): the
+        # state-mode race must leave a record even when a mode loses —
+        # that line IS the "measured dead end" evidence for BASELINE.md
+        print(json.dumps(
+            {"metric": f"headline_b{batch}_remat{remat}_{mode_name}",
+             "sweep_samples_per_sec": round(sps, 2),
+             "step_ms": round(dt * 1e3, 2)}), flush=True)
         if best is None or sps > best[0]:
-            best = (sps, batch, remat, dt)
+            best = (sps, batch, remat, mode_name, dt)
     if best is None:
         raise RuntimeError(
             "every headline config failed (see the error lines above)")
-    sps, batch, remat, dt = best
+    sps, batch, remat, mode_name, dt = best
     tflops = 6 * BERT_LARGE_PARAMS * batch * seq / dt / 1e12 if on_tpu \
         else 0.0
     extra.update({"batch": batch, "seq": seq, "remat": remat,
+                  "state_mode": mode_name,
                   "step_ms": round(dt * 1e3, 2), "tflops": round(tflops, 1)})
     emit(metric, sps, "samples/sec/chip", extra=extra)
 
 
 # -- compiled-kernel numerics parity ----------------------------------------
 
-def bench_kernel_parity(on_tpu):
+def bench_kernel_parity(on_tpu, quiet=False):
     """Compiled-Mosaic vs plain-jnp numerics for every Pallas kernel
     family. CI runs the kernels in interpret mode on the CPU rig (1-core
     host, no chip), so a Mosaic miscompile would pass the whole suite
     and first surface as a bad loss — this config closes that hole at
     driver time by asserting parity ON the chip (round-4 verdict weak
-    #7). Emits one pass/fail line; failures name the check."""
+    #7). Emits one pass/fail line; failures name the check. ``quiet``
+    (the pollution instrument's replay) skips the emit so the throwaway
+    run leaves no metric record."""
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
     from apex_tpu.normalization import (fused_layer_norm_affine,
                                         fused_rms_norm_affine)
@@ -1074,7 +1263,34 @@ def bench_kernel_parity(on_tpu):
     check("adam_flat_vs_tree", 1e-5, step3(o_flat), step3(o_tree),
           params, grads)
 
+    # reduced-precision state modes: bf16-m flat kernel vs the bf16-m
+    # tree path (same round-to-nearest m store on both sides), and the
+    # kernel's fused cast-out vs a plain jnp cast of the tree result
+    o_tree_bf = FusedAdam(lr=1e-3, weight_decay=0.01,
+                          m_dtype=jnp.bfloat16)
+    o_flat_bf = FusedAdam(lr=1e-3, weight_decay=0.01,
+                          m_dtype=jnp.bfloat16, use_flat_kernel=True)
+    check("adam_bf16m_flat_vs_tree", 1e-5, step3(o_flat_bf),
+          step3(o_tree_bf), params, grads)
+
+    o_emit = FusedAdam(lr=1e-3, weight_decay=0.01,
+                       emit_compute_params=True, use_flat_kernel=True)
+    st_emit = o_emit.init(params)
+
+    def castout_kernel(params, grads):
+        _, _, c = o_emit.step(grads, params, st_emit)
+        return c
+
+    def castout_ref(params, grads):
+        p = step3(o_tree)(params, grads)
+        return jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
+
+    check("adam_castout_vs_jnp_cast", 1e-5, castout_kernel, castout_ref,
+          params, grads)
+
     failures = [n for n, (d, tol) in results.items() if d > tol]
+    if quiet:
+        return failures
     emit("kernel_parity_compiled", 0.0 if failures else 1.0, "pass",
          extra={"checks": len(results), "failures": failures,
                 "rel_diffs": {n: d for n, (d, _) in results.items()},

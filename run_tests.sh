@@ -6,6 +6,12 @@
 #                           #     devices via tests/conftest.py)
 #   ./run_tests.sh L1       # L1: loss-curve parity sweeps (slower)
 #   ./run_tests.sh all      # both
+#   ./run_tests.sh quick    # fast high-signal subset (-m quick) for the
+#                           #     inner loop; full tier stays in CI
+#   ./run_tests.sh gate     # L1 loss-curve gate: amp levels AND the
+#                           #     reduced-precision optimizer-state modes
+#                           #     (bf16 m, fused cast-out) must track the
+#                           #     fp32 golden curve — run on every PR
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -15,8 +21,11 @@ cd "$(dirname "$0")"
 tier="${1:-L0}"
 shift || true
 case "$tier" in
-  L0)  exec python -m pytest tests/L0 -q "$@" ;;
-  L1)  exec python -m pytest tests/L1 -q "$@" ;;
-  all) exec python -m pytest tests -q "$@" ;;
-  *)   echo "usage: $0 [L0|L1|all] [pytest args...]" >&2; exit 2 ;;
+  L0)    exec python -m pytest tests/L0 -q "$@" ;;
+  L1)    exec python -m pytest tests/L1 -q "$@" ;;
+  all)   exec python -m pytest tests -q "$@" ;;
+  quick) exec python -m pytest tests -q -m quick "$@" ;;
+  gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
+  *)     echo "usage: $0 [L0|L1|all|quick|gate] [pytest args...]" >&2
+         exit 2 ;;
 esac

@@ -113,3 +113,35 @@ def test_o2_end_to_end_train_step_matches_fp32_direction():
     assert jnp.isfinite(loss)
     # scale advanced one clean step
     assert int(state.unskipped) == 1
+
+
+def test_o2_cast_model_consumes_precast():
+    """``cast_model(precast=...)`` (optimizer fused cast-out): matching-
+    dtype leaves are taken VERBATIM (same array object — no recast),
+    keep-fp32 norm leaves still come from the master tree, and a
+    mismatched precast leaf falls back to casting master."""
+    h = amp.initialize("O2", verbosity=0)
+    master = _params()
+    pre = jax.tree.map(lambda x: (x + 1).astype(jnp.bfloat16), master)
+    p = h.cast_model(master, precast=pre)
+    # bf16 leaf consumed verbatim — the emitted values, not master's
+    assert p["dense"]["kernel"] is pre["dense"]["kernel"]
+    # norm leaves stay fp32 and come from master (precast dtype mismatch)
+    assert p["batch_norm"]["scale"].dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(p["batch_norm"]["scale"]),
+                                  np.asarray(master["batch_norm"]["scale"]))
+
+
+def test_model_params_from_master_precast():
+    from apex_tpu.amp import policy
+
+    master = _params()
+    like = {"dense": {"kernel": jnp.zeros((4, 4), jnp.bfloat16)},
+            "batch_norm": {"scale": jnp.zeros((4,), jnp.float32),
+                           "bias": jnp.zeros((4,), jnp.float32)}}
+    pre = jax.tree.map(lambda x: (x * 2).astype(jnp.bfloat16), master)
+    got = policy.model_params_from_master(master, like, precast=pre)
+    assert got["dense"]["kernel"] is pre["dense"]["kernel"]
+    assert got["batch_norm"]["scale"].dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got["batch_norm"]["scale"]),
+                                  np.asarray(master["batch_norm"]["scale"]))

@@ -20,11 +20,13 @@ from apex_tpu.models.gpt import (
 )
 from apex_tpu.transformer import parallel_state as ps
 
-# B stays 4 (num_microbatches=4 needs B divisible by 4); S=16 halves
-# the attention/scan work of every config vs the original 32 and keeps
-# the SP divisibility (tp=2 | S) intact — suite-time satellite of the
-# d=64 PR
-B, S = 4, 16
+# S=16 halves the attention/scan work of every config vs the original
+# 32 and keeps the SP divisibility (tp=2 | S) intact (d=64 PR); B drops
+# 4->2 with num_microbatches 4->2 (B must stay divisible — the
+# schedules mask the extra warmup ticks, so M < pp is fine) — suite-time
+# satellite of the optimizer-state PR. S can't shrink further: the cp=8
+# ring needs 2 causal chunks per rank (16 | S).
+B, S, MICROBATCHES = 2, 16, 2
 
 
 def _data(cfg):
@@ -121,7 +123,8 @@ def test_pipeline_gpt_matches_unsharded(pp, vpp, tp, sp, rope):
     kw = {"virtual_pipeline_size": vpp} if vpp else {}
 
     def run(p, b):
-        loss, grads = fwd_bwd(pipe_model, p, b, num_microbatches=4, **kw)
+        loss, grads = fwd_bwd(pipe_model, p, b,
+                              num_microbatches=MICROBATCHES, **kw)
         return loss, model.allreduce_sequence_parallel_grads(grads)
 
     loss, grads = jax.jit(ps.shard_map(

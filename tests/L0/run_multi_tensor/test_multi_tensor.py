@@ -185,3 +185,39 @@ def test_applier_callable_reference_arity():
     assert seen == {"chunk_size": 4096, "noop_flag": "noop",
                     "n_lists": 1, "alpha": 2.0}
     np.testing.assert_allclose(np.asarray(out[0]), np.full(3, 2.0))
+
+
+def test_flat_adam_kernel_bf16_moment_and_castout():
+    """Kernel-level reduced-precision contract: bf16 m in/out with fp32
+    accumulate (== round-to-nearest of the fp32 m), and the optional 4th
+    output == the updated params cast to the emit dtype, bit for bit."""
+    n = 5000
+    g = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
+    p = jax.random.normal(jax.random.PRNGKey(4), (n,), jnp.float32)
+    (gbuf, spec) = flatten_tensors([g])
+    (pbuf, _) = flatten_tensors([p], spec)
+    kw = dict(lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8, step=3,
+              weight_decay=0.01, adam_w_mode=True)
+
+    m32 = jnp.full_like(pbuf, 0.25)
+    v32 = jnp.full_like(pbuf, 0.5)
+    p_ref, m_ref, v_ref = kernels.flat_adam(gbuf, pbuf, m32, v32, **kw)
+
+    outs = kernels.flat_adam(gbuf, pbuf, m32.astype(jnp.bfloat16), v32,
+                             emit_compute_dtype=jnp.bfloat16, **kw)
+    assert len(outs) == 4
+    p_bf, m_bf, v_bf, pc = outs
+    assert m_bf.dtype == jnp.bfloat16 and v_bf.dtype == jnp.float32
+    # m32 is bf16-exact, so the fp32-accumulated m must round to exactly
+    # the fp32 path's m, and v must match bit for bit
+    np.testing.assert_array_equal(
+        np.asarray(m_bf, np.float32),
+        np.asarray(m_ref.astype(jnp.bfloat16), np.float32))
+    np.testing.assert_array_equal(np.asarray(v_bf), np.asarray(v_ref))
+    np.testing.assert_allclose(np.asarray(p_bf), np.asarray(p_ref),
+                               rtol=1e-5, atol=1e-6)
+    # fused cast-out == cast of the kernel's own updated params
+    assert pc.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(pc, np.float32),
+        np.asarray(p_bf.astype(jnp.bfloat16), np.float32))

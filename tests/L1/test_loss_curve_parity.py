@@ -22,13 +22,19 @@ from apex_tpu.optimizers import FusedAdam
 STEPS = 20
 
 
-def bert_curve(opt_level, loss_scale="dynamic", seed=0):
-    """Loss curve of a full amp train loop on deterministic data."""
+def bert_curve(opt_level, loss_scale="dynamic", seed=0,
+               m_dtype=jnp.float32, emit_compute=False):
+    """Loss curve of a full amp train loop on deterministic data.
+
+    ``m_dtype``/``emit_compute`` exercise the reduced-precision optimizer
+    state modes: bf16 first moment, and the fused bf16 cast-out consumed
+    by ``cast_model(precast=...)`` instead of the per-step master cast."""
     cfg = bert_tiny()
     h = amp.initialize(opt_level=opt_level, loss_scale=loss_scale,
                        verbosity=0)
     params = init_bert(jax.random.PRNGKey(seed), cfg)
-    opt = FusedAdam(lr=5e-4, weight_decay=0.01)
+    opt = FusedAdam(lr=5e-4, weight_decay=0.01, m_dtype=m_dtype,
+                    emit_compute_params=emit_compute)
     opt_state = opt.init(params)
     scaler_state = h.init_state()
 
@@ -38,8 +44,8 @@ def bert_curve(opt_level, loss_scale="dynamic", seed=0):
         return ids, jnp.ones_like(ids)
 
     @jax.jit
-    def step(master, opt_state, scaler_state, ids, mask):
-        p = h.cast_model(master)
+    def step(master, opt_state, scaler_state, compute, ids, mask):
+        p = h.cast_model(master, precast=compute)
 
         def loss_fn(p):
             out = apply_bert(p, cfg, ids, mask)
@@ -48,15 +54,22 @@ def bert_curve(opt_level, loss_scale="dynamic", seed=0):
         with h.autocast():
             loss, grads, found_inf, scaler_state = h.value_and_grad(
                 loss_fn)(p, scaler_state)
-        master, opt_state = opt.step(grads, master, opt_state,
-                                     found_inf=found_inf)
-        return master, opt_state, scaler_state, loss
+        if emit_compute:
+            master, opt_state, compute = opt.step(
+                grads, master, opt_state, found_inf=found_inf,
+                compute_params=p)
+        else:
+            master, opt_state = opt.step(grads, master, opt_state,
+                                         found_inf=found_inf)
+            compute = None
+        return master, opt_state, scaler_state, compute, loss
 
+    compute = h.cast_model(params) if emit_compute else None
     losses = []
     for i in range(STEPS):
         ids, mask = batch(i)
-        params, opt_state, scaler_state, loss = step(
-            params, opt_state, scaler_state, ids, mask)
+        params, opt_state, scaler_state, compute, loss = step(
+            params, opt_state, scaler_state, compute, ids, mask)
         losses.append(float(loss))
     return np.array(losses)
 
@@ -82,6 +95,25 @@ def test_amp_curve_tracks_fp32(golden_curve, opt_level):
     assert curve[-1] < curve[0] - 0.1
     # the curves must NOT be identical — proof reduced precision ran
     assert np.any(curve != golden_curve)
+
+
+def test_state_dtype_bf16_m_curve_tracks_fp32(golden_curve):
+    """L1 gate for the reduced-precision optimizer state: O2 with bf16
+    Adam first moments must track the fp32 golden curve within the same
+    mixed-precision tolerance as plain O2."""
+    curve = bert_curve("O2", m_dtype=jnp.bfloat16)
+    assert np.all(np.isfinite(curve))
+    np.testing.assert_allclose(curve, golden_curve, rtol=0.05)
+    assert curve[-1] < curve[0] - 0.1
+
+
+def test_state_dtype_castout_curve_tracks_fp32(golden_curve):
+    """Full HBM-saving recipe: bf16 m AND the fused bf16 cast-out feeding
+    ``cast_model(precast=...)`` — the train loop never re-casts master."""
+    curve = bert_curve("O2", m_dtype=jnp.bfloat16, emit_compute=True)
+    assert np.all(np.isfinite(curve))
+    np.testing.assert_allclose(curve, golden_curve, rtol=0.05)
+    assert curve[-1] < curve[0] - 0.1
 
 
 def test_gpt_converges():

@@ -43,6 +43,29 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
+# ``quick`` tier (`./run_tests.sh quick` == `-m quick`): everything
+# OUTSIDE the compile- and subprocess-heavy modules below and the L1
+# convergence sweeps — the contributor/driver inner loop. The full
+# `-m 'not slow'` tier remains the gate; quick only ADDS a marker, it
+# never hides a test from the default run.
+_HEAVY_MODULES = {
+    "test_bench_parent.py",     # bench.py subprocesses
+    "test_resume.py",           # kill-and-resume subprocess
+    "test_graft_entry.py",      # in-process dryrun (all mesh shapes)
+    "test_gpt.py",              # tp8/pp/cp shard_map compiles
+    "test_models.py",           # resnet18/50 builds
+    "test_determinism.py",      # profiler + bitwise train steps
+    "test_pipeline_memory.py",  # compiled-memory analysis
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        p = item.path
+        if p.name not in _HEAVY_MODULES and "L1" not in p.parts:
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(autouse=True)
 def _reset_parallel_state():
     """Each test starts with no global mesh installed."""
