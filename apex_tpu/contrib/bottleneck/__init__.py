@@ -1,0 +1,5 @@
+from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    init_spatial_bottleneck,
+    spatial_bottleneck,
+    spatial_parallel_bottleneck,
+)

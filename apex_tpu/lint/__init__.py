@@ -1,0 +1,65 @@
+"""apxlint — static contract checker for apex_tpu.
+
+The repo's load-bearing invariants (in-place ``input_output_aliases`` on
+the optimizer kernels, fp32 flash-attention softmax statistics, VMEM
+block budgets, deterministic collective ordering inside shard_map
+bodies, the O1 autocast op lists) are enforced at runtime only by tests
+that happen to execute the right branch. This package checks them at
+review time instead: an AST pass over every module plus a trace-time
+abstract evaluation of the registered kernel configurations.
+
+Run it as ``python -m apex_tpu.lint apex_tpu/ tests/``. Each check has
+an error code (catalogue below, details in
+``docs/source/static_analysis.rst``) and can be suppressed on a single
+line with ``# apxlint: disable=CODE`` (on the flagged line or on a
+standalone comment line directly above it). Files whose first lines
+contain ``# apxlint: fixture`` are test fixtures: directory walks skip
+them, explicit paths lint them.
+"""
+
+from dataclasses import dataclass
+
+#: code -> one-line contract description. The docstring of each checker
+#: module carries the full rationale.
+CODES = {
+    "APX100": "lint internal: a registered trace config failed to "
+              "evaluate (the kernel it covers is unverifiable)",
+    "APX101": "pallas kernel updates an input operand in place "
+              "(stem-matched X_ref -> X_out pair) without the matching "
+              "input_output_aliases entry",
+    "APX102": "pallas_call VMEM block residency (2x streaming blocks "
+              "+ scratch) exceeds the per-kernel budget",
+    "APX103": "flash/softmax statistics tile (m, l, lse, mean, rstd) "
+              "stored or allocated below fp32",
+    "APX201": "collective sequence diverges across the branches of a "
+              "rank-dependent conditional (multi-chip deadlock)",
+    "APX202": "collective axis name does not resolve to a "
+              "parallel_state mesh axis",
+    "APX301": "op appears in more than one AMP policy list "
+              "(FP16_FUNCS / FP32_FUNCS / CASTS)",
+    "APX302": "op intercepted by cast_args() appears in no AMP policy "
+              "list",
+    "APX303": "op listed in an AMP policy list is neither intercepted "
+              "by cast_args() nor declared in UNWIRED",
+    "APX304": "op declared UNWIRED is actually intercepted by "
+              "cast_args() (stale exemption)",
+    "APX401": "host-state read (time.*, np.random.*, random.*) in a "
+              "function reachable from a jit/custom_vjp/kernel body",
+    "APX402": "global-statement write in a function reachable from a "
+              "jit/custom_vjp/kernel body",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation, addressable by (path, line) for suppression."""
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+__all__ = ["CODES", "Finding"]

@@ -1,0 +1,67 @@
+"""Command-line entry point: ``python -m apex_tpu.lint [paths...]``.
+
+Exit status is 0 when every check passes, 1 when any finding survives
+suppression — suitable as a blocking CI step. ``--no-trace`` skips the
+trace-time VMEM budget pass (APX102) for a pure-AST run that needs no
+jax import; ``--select`` narrows to a comma-separated code list.
+"""
+
+import argparse
+import sys
+
+from apex_tpu.lint import CODES
+from apex_tpu.lint.engine import lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="apxlint — static contract checker for apex_tpu "
+                    "Pallas kernels, collectives, and AMP op lists.")
+    ap.add_argument("paths", nargs="*", default=["apex_tpu"],
+                    help="files or directories to lint "
+                         "(default: apex_tpu)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the trace-time VMEM budget pass (APX102)")
+    ap.add_argument("--select", default=None, metavar="CODES",
+                    help="comma-separated codes to report "
+                         "(e.g. APX101,APX201)")
+    ap.add_argument("--include-fixtures", action="store_true",
+                    help="also lint files marked '# apxlint: fixture'")
+    ap.add_argument("--list-codes", action="store_true",
+                    help="print the error-code catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        for code, doc in sorted(CODES.items()):
+            print(f"{code}  {doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",") if
+                  c.strip()}
+        unknown = select - set(CODES)
+        if unknown:
+            print(f"unknown codes: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["apex_tpu"]
+    findings, n_files = lint_paths(paths,
+                                   include_fixtures=args.include_fixtures,
+                                   trace=not args.no_trace,
+                                   select=select)
+    for f in findings:
+        print(f.render())
+    tail = f"{n_files} file(s) checked"
+    if findings:
+        print(f"apxlint: {len(findings)} finding(s), {tail}",
+              file=sys.stderr)
+        return 1
+    print(f"apxlint: clean, {tail}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,402 @@
+"""Collective-order and axis-resolution checks (APX201, APX202).
+
+**APX201** — inside a ``shard_map`` or scanned-schedule body every
+participant must issue the same collectives in the same order; a
+``psum`` that only some ranks reach is a multi-chip deadlock, not an
+error message. Statically, the dangerous shape is a *rank-dependent*
+conditional (a Python ``if`` whose predicate derives from
+``axis_index`` / ``process_index`` / a ``parallel_state`` rank or stage
+query) whose branches trace different collective sequences. The check
+symbolically executes each function body, building the set of
+collective sequences along every path (early returns terminate a
+path), and compares the branch path-sets at each rank-dependent split.
+Config-dependent branches (``if cp > 1:``, ``if p.dtype == bool:``)
+are trace-time constants — identical on every rank — and are *not*
+compared, which keeps the check silent on the static dispatch branches
+in ``mappings.py`` / ``context_parallel.py``. ``lax.cond`` /
+``lax.switch`` branch callables execute under a traced predicate, so
+those are always compared when they resolve to local functions.
+
+**APX202** — every axis name handed to a collective must resolve to a
+``parallel_state`` mesh axis (or an axis literally declared in the same
+file via ``Mesh``/``PartitionSpec``/``axis_name=`` — the test-local
+mesh idiom). Axis arguments are resolved through string literals,
+``ps.X_AXIS`` constants, module-level aliases (``_AXIS =
+ps.TENSOR_AXIS``), parameter defaults, and single local assignments;
+anything unresolvable is skipped, never guessed.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.astutil import attr_chain, call_name, walk_scope
+
+# collectives whose relative order is a cross-chip contract
+_ORDERED = {"psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+            "all_gather", "all_to_all", "psum_scatter", "all_to_all_p"}
+# axis-consuming calls checked by APX202 (ordered ones + index queries)
+_AXIS_USERS = _ORDERED | {"axis_index", "axis_size"}
+# (call name -> positional index of the axis-name argument)
+_AXIS_ARG_POS = {name: 1 for name in _ORDERED}
+_AXIS_ARG_POS.update({"axis_index": 0, "axis_size": 0})
+
+_RANKISH_NAMES = re.compile(
+    r"(^|_)(rank|stage)(_|$)|axis_index|process_index")
+_MAX_PATHS = 64
+
+
+def _parallel_state_axes() -> Set[str]:
+    """Mesh axis names, read from parallel_state.py's own AST (no jax
+    import needed at lint time)."""
+    ps_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "transformer", "parallel_state.py")
+    axes: Set[str] = set()
+    try:
+        with open(ps_path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return {"data", "pipe", "context", "model"}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Name) and t.id.endswith("_AXIS")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                _AXIS_CONSTANTS[t.id] = node.value.value
+                axes.add(node.value.value)
+    return axes or {"data", "pipe", "context", "model"}
+
+
+_AXIS_CONSTANTS: Dict[str, str] = {}  # e.g. DATA_AXIS -> "data"
+_VALID_AXES: Optional[Set[str]] = None
+
+
+def _valid_axes() -> Set[str]:
+    global _VALID_AXES
+    if _VALID_AXES is None:
+        _VALID_AXES = _parallel_state_axes()
+    return _VALID_AXES
+
+
+def _local_axes(tree: ast.Module) -> Set[str]:
+    """Axis names declared in this file: strings inside Mesh()/P()/
+    PartitionSpec()/make_mesh() calls and axis_name(s)= kwargs."""
+    axes: Set[str] = set()
+
+    def strings_under(node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                axes.add(n.value)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in ("Mesh", "AbstractMesh", "make_mesh", "P",
+                    "PartitionSpec"):
+            strings_under(node)
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                strings_under(kw.value)
+    return axes
+
+
+class _Env:
+    """Name -> axis-string resolution context for one function."""
+
+    def __init__(self, module_aliases: Dict[str, str]):
+        self.names: Dict[str, str] = dict(module_aliases)
+        self.rank_vars: Set[str] = set()
+
+
+def _module_aliases(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        val = _resolve_axis_expr(node.value, None)
+        if val is not None:
+            out[node.targets[0].id] = val
+    return out
+
+
+def _resolve_axis_expr(node: ast.AST,
+                       env: Optional["_Env"]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute):
+        if node.attr in _AXIS_CONSTANTS:
+            return _AXIS_CONSTANTS[node.attr]
+        return None
+    if isinstance(node, ast.Name) and env is not None:
+        return env.names.get(node.id)
+    return None
+
+
+def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    name = call_name(call)
+    kw_axis = None
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            kw_axis = kw.value
+    pos = _AXIS_ARG_POS.get(name)
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return kw_axis
+
+
+def _resolved_axes(call: ast.Call, env: _Env) -> Tuple[List[str], bool]:
+    """(resolved axis names, fully_resolved). Tuples resolve per-element."""
+    arg = _axis_arg(call)
+    if arg is None:
+        return [], False
+    nodes = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+    out, complete = [], True
+    for n in nodes:
+        v = _resolve_axis_expr(n, env)
+        if v is None:
+            complete = False
+        else:
+            out.append(v)
+    return out, complete
+
+
+def _seed_env(fn: ast.FunctionDef, env: _Env) -> None:
+    """Parameter defaults and simple local assigns, for axis resolution
+    and rank-variable tracking."""
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    for param, default in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        v = _resolve_axis_expr(default, env)
+        if v is not None:
+            env.names[param.arg] = v
+    for param, default in zip(a.kwonlyargs, a.kw_defaults):
+        if default is not None:
+            v = _resolve_axis_expr(default, env)
+            if v is not None:
+                env.names[param.arg] = v
+    for node in walk_scope(fn):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tgt = node.targets[0].id
+        v = _resolve_axis_expr(node.value, env)
+        if v is not None:
+            env.names.setdefault(tgt, v)
+        if _is_rankish(node.value, env):
+            env.rank_vars.add(tgt)
+
+
+def _is_rankish(expr: ast.AST, env: _Env) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            if n.id in env.rank_vars or _RANKISH_NAMES.search(n.id):
+                return True
+        elif isinstance(n, ast.Attribute):
+            if _RANKISH_NAMES.search(n.attr):
+                return True
+    return False
+
+
+# -- path-sensitive collective sequences ------------------------------------
+
+_Event = Tuple[str, Tuple[str, ...]]
+_PathSet = Set[Tuple[_Event, ...]]
+
+
+class _TooManyPaths(Exception):
+    pass
+
+
+def _expr_events(node: ast.AST, env: _Env,
+                 defs: Dict[str, ast.FunctionDef],
+                 depth: int) -> List[_Event]:
+    """Collective events issued while evaluating an expression, in
+    source order. Calls to local functions contribute their (merged)
+    sequences only when unambiguous; unknown callees are opaque."""
+    events: List[_Event] = []
+    for n in ast.iter_child_nodes(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        events.extend(_expr_events(n, env, defs, depth))
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _ORDERED:
+            axes, _ = _resolved_axes(node, env)
+            events.append((name, tuple(axes)))
+        elif name in ("cond", "switch"):
+            pass  # handled as a statement-level split by the caller
+        elif (isinstance(node.func, ast.Name) and node.func.id in defs
+                and depth < 4):
+            sub = defs[node.func.id]
+            seqs = _function_paths(sub, env, defs, depth + 1)
+            if len(seqs) == 1:
+                events.extend(next(iter(seqs)))
+            # divergent callees are reported at their own definition
+    return events
+
+
+def _branch_paths(call: ast.Call, env: _Env,
+                  defs: Dict[str, ast.FunctionDef],
+                  depth: int) -> Optional[List[_PathSet]]:
+    """Path-sets of lax.cond/lax.switch branch callables that resolve
+    to local named functions; None when any branch is opaque."""
+    branches = []
+    args = call.args[1:]
+    if (call_name(call) == "switch" and len(args) == 1
+            and isinstance(args[0], (ast.List, ast.Tuple))):
+        args = args[0].elts
+    for a in args:
+        if isinstance(a, ast.Name) and a.id in defs:
+            branches.append(_function_paths(defs[a.id], env, defs,
+                                            depth + 1))
+        elif isinstance(a, ast.Lambda):
+            evs = tuple(_expr_events(a.body, env, defs, depth + 1))
+            branches.append({evs})
+        else:
+            return None
+    return branches if len(branches) >= 2 else None
+
+
+def _stmt_paths(stmts, env, defs, depth, findings, path):
+    """Returns (open_paths, closed_paths) for a statement list."""
+    open_paths: _PathSet = {()}
+    closed: _PathSet = set()
+
+    def extend(events: List[_Event]):
+        nonlocal open_paths
+        if events:
+            open_paths = {p + tuple(events) for p in open_paths}
+
+    for stmt in stmts:
+        if isinstance(stmt, ast.If):
+            cond_events = _expr_events(stmt.test, env, defs, depth)
+            extend(cond_events)
+            t_open, t_closed = _stmt_paths(stmt.body, env, defs, depth,
+                                           findings, path)
+            e_open, e_closed = _stmt_paths(stmt.orelse, env, defs, depth,
+                                           findings, path)
+            if _is_rankish(stmt.test, env):
+                t_all = t_open | t_closed
+                e_all = e_open | e_closed
+                if t_all != e_all:
+                    findings.append(Finding(
+                        "APX201", path, stmt.lineno,
+                        "collective sequence differs between the "
+                        "branches of this rank-dependent conditional "
+                        f"({_describe(t_all)} vs {_describe(e_all)}) — "
+                        "ranks would issue mismatched collectives"))
+            new_open = {p + b for p in open_paths for b in t_open | e_open}
+            closed |= {p + b for p in open_paths for b in t_closed | e_closed}
+            open_paths = new_open
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                extend(_expr_events(stmt.value, env, defs, depth))
+            closed |= open_paths
+            open_paths = set()
+            break
+        elif isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                extend(_expr_events(stmt.iter, env, defs, depth))
+            else:
+                extend(_expr_events(stmt.test, env, defs, depth))
+            b_open, b_closed = _stmt_paths(stmt.body, env, defs, depth,
+                                           findings, path)
+            closed |= {p + b for p in open_paths for b in b_closed}
+            open_paths = {p + b for p in open_paths for b in b_open}
+        elif isinstance(stmt, (ast.With, ast.Try)):
+            body = stmt.body
+            b_open, b_closed = _stmt_paths(body, env, defs, depth,
+                                           findings, path)
+            closed |= {p + b for p in open_paths for b in b_closed}
+            open_paths = {p + b for p in open_paths for b in b_open}
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            continue
+        else:
+            for call in _calls_in_order(stmt):
+                if call_name(call) in ("cond", "switch"):
+                    branches = _branch_paths(call, env, defs, depth)
+                    if branches:
+                        base = branches[0]
+                        for other in branches[1:]:
+                            if other != base:
+                                findings.append(Finding(
+                                    "APX201", path, call.lineno,
+                                    "lax.cond/lax.switch branches trace "
+                                    "different collective sequences "
+                                    f"({_describe(base)} vs "
+                                    f"{_describe(other)})"))
+                                break
+                        if len(base) == 1:
+                            extend(list(next(iter(base))))
+            extend(_expr_events(stmt, env, defs, depth))
+        if len(open_paths) + len(closed) > _MAX_PATHS:
+            raise _TooManyPaths()
+    return open_paths, closed
+
+
+def _calls_in_order(stmt: ast.AST) -> List[ast.Call]:
+    return [n for n in walk_scope(stmt) if isinstance(n, ast.Call)]
+
+
+def _describe(paths: _PathSet) -> str:
+    names = sorted({",".join(e[0] for e in p) or "<none>" for p in paths})
+    return "{" + " | ".join(names[:4]) + "}"
+
+
+def _function_paths(fn, env, defs, depth) -> _PathSet:
+    sub_env = _Env(env.names)
+    _seed_env(fn, sub_env)
+    try:
+        o, c = _stmt_paths(fn.body, sub_env, defs, depth, [], "")
+    except (_TooManyPaths, RecursionError):
+        return {()}
+    return (o | c) or {()}
+
+
+# -- module entry ------------------------------------------------------------
+
+def check_module(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    aliases = _module_aliases(tree)
+    valid = _valid_axes() | _local_axes(tree)
+    defs: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            defs.setdefault(n.name, n)
+
+    # APX202: every resolvable axis argument must name a mesh axis
+    for fn in defs.values():
+        env = _Env(aliases)
+        _seed_env(fn, env)
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _AXIS_USERS:
+                continue
+            axes, _ = _resolved_axes(node, env)
+            for ax in axes:
+                if ax not in valid:
+                    findings.append(Finding(
+                        "APX202", path, node.lineno,
+                        f"collective axis {ax!r} is not a parallel_state "
+                        f"mesh axis (known: {sorted(valid)[:8]})"))
+
+    # APX201: rank-dependent branch divergence, per function
+    for fn in defs.values():
+        env = _Env(aliases)
+        _seed_env(fn, env)
+        local: List[Finding] = []
+        try:
+            _stmt_paths(fn.body, env, defs, 0, local, path)
+        except (_TooManyPaths, RecursionError):
+            continue
+        findings.extend(local)
+    return findings

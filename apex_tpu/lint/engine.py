@@ -1,0 +1,145 @@
+"""apxlint driver: file walking, suppression comments, check dispatch.
+
+The engine owns everything that is not a check: collecting ``.py``
+files, parsing them once, reading ``# apxlint: disable=CODE`` comments
+(flagged line, or a standalone comment line directly above it), and
+skipping ``# apxlint: fixture`` files during directory walks so the
+known-bad test fixtures don't fail the repo-wide run while still being
+lintable when passed as explicit paths.
+
+Checks come in two shapes:
+
+- per-file AST checks (``kernels``, ``collectives``, ``hygiene``) get
+  ``(tree, path)`` and return findings;
+- project checks run once over the whole file set: ``amp_lists`` (needs
+  the op-list module and every call site together) and ``vmem`` (the
+  trace-time budget evaluation of the registered kernel configs,
+  skipped with ``trace=False``).
+"""
+
+import ast
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.lint import CODES, Finding
+
+_SUPPRESS_RE = re.compile(r"#\s*apxlint:\s*disable=([A-Z0-9,\s]+)")
+_FIXTURE_RE = re.compile(r"#\s*apxlint:\s*fixture")
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".pytest_cache",
+              "build", "dist"}
+
+
+def collect_files(paths: Sequence[str],
+                  include_fixtures: bool = False) -> List[str]:
+    """Expand files/directories into a sorted list of lintable .py files."""
+    out: Set[str] = set()
+    for p in paths:
+        if os.path.isfile(p):
+            out.add(os.path.abspath(p))  # explicit paths always lint
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                fp = os.path.abspath(os.path.join(root, f))
+                if not include_fixtures and is_fixture_file(fp):
+                    continue
+                out.add(fp)
+    return sorted(out)
+
+
+def is_fixture_file(path: str) -> bool:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            head = "".join(fh.readline() for _ in range(3))
+    except OSError:
+        return False
+    return bool(_FIXTURE_RE.search(head))
+
+
+def parse_suppressions(src: str) -> Dict[int, Set[str]]:
+    """Map line number -> suppressed codes on that line.
+
+    An inline comment suppresses its own line; a standalone comment line
+    suppresses itself and the following line, so multi-code disables can
+    sit above long statements.
+    """
+    sup: Dict[int, Set[str]] = {}
+    lines = src.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        sup.setdefault(i, set()).update(codes)
+        if line.lstrip().startswith("#"):  # standalone comment line
+            sup.setdefault(i + 1, set()).update(codes)
+    return sup
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with tokenize.open(path) as fh:  # honors PEP 263 encodings
+            return fh.read()
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return None
+
+
+def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
+               trace: bool = True,
+               select: Optional[Iterable[str]] = None
+               ) -> Tuple[List[Finding], int]:
+    """Run all checks over ``paths``; returns (findings, files_checked)."""
+    from apex_tpu.lint import amp_lists, collectives, hygiene, kernels
+
+    files = collect_files(paths, include_fixtures=include_fixtures)
+    findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.Module] = {}
+
+    for path in files:
+        src = _read(path)
+        if src is None:
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "APX100", path, e.lineno or 1,
+                f"file does not parse: {e.msg}"))
+            continue
+        sources[path] = src
+        trees[path] = tree
+        for checker in (kernels, collectives, hygiene):
+            findings.extend(checker.check_module(tree, path))
+
+    findings.extend(amp_lists.check_files(trees))
+    if trace:
+        from apex_tpu.lint import vmem
+        findings.extend(vmem.check_repo())
+
+    findings = _apply_suppressions(findings, sources)
+    if select is not None:
+        keep = tuple(select)
+        findings = [f for f in findings if f.code.startswith(keep)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, len(trees)
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sources: Dict[str, str]) -> List[Finding]:
+    by_file: Dict[str, Dict[int, Set[str]]] = {}
+    out = []
+    for f in findings:
+        if f.code not in CODES:
+            raise ValueError(f"checker emitted unregistered code {f.code}")
+        if f.path not in by_file and f.path in sources:
+            by_file[f.path] = parse_suppressions(sources[f.path])
+        sup = by_file.get(f.path, {})
+        if f.code in sup.get(f.line, ()):
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,171 @@
+"""Tracer-hygiene checks (APX401, APX402).
+
+A function traced by jax (a ``jit``/``grad``/``scan`` body, a
+``custom_vjp`` rule, a Pallas kernel) runs ONCE at trace time; any host
+state it reads is baked into the compiled program as a constant. A
+``time.time()`` timestamp, an ``np.random`` draw, or a mutated global
+inside such a function is a silent staleness bug: the program keeps
+replaying the value captured at trace time. Host-side code (metrics,
+mesh initialization) is free to do all of these — so the check first
+builds the set of functions *reachable from a trace root* and only
+flags violations inside that set.
+
+Trace roots in a module: functions decorated with (or passed to)
+``jax.custom_vjp``/``custom_jvp``/``jit``/``checkpoint``/``remat``,
+arguments of ``.defvjp(...)``, Pallas kernel bodies (first argument of
+``pallas_call``, through ``functools.partial``), and named functions
+passed to ``grad``/``value_and_grad``/``vjp``/``vmap``/``pmap``/
+``shard_map``/``scan``/``cond``/``switch``/``while_loop``/
+``fori_loop``. Reachability closes transitively over calls to
+module-local function names.
+
+Host-module references (``time``, ``random``, ``numpy``/``np.random``,
+``datetime``) are matched against the module's actual imports, so
+``from jax import random`` never false-positives.
+"""
+
+import ast
+from typing import Dict, List, Set
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.astutil import attr_chain, call_name
+
+_TRANSFORMS = {
+    "jit", "grad", "value_and_grad", "vjp", "jvp", "vmap", "pmap",
+    "shard_map", "scan", "cond", "switch", "while_loop", "fori_loop",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "pallas_call",
+    "named_call",
+}
+_DECORATOR_ROOTS = {"custom_vjp", "custom_jvp", "jit", "checkpoint",
+                    "remat"}
+
+
+def _host_modules(tree: ast.Module) -> Dict[str, str]:
+    """Local alias -> canonical host-module name, from this module's
+    imports only."""
+    out: Dict[str, str] = {}
+    interesting = {"time", "random", "numpy", "datetime"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root in interesting:
+                    out[a.asname or root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "numpy":
+                for a in node.names:
+                    if a.name == "random":
+                        out[a.asname or "random"] = "numpy.random"
+    return out
+
+
+def _function_table(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    table: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            table.setdefault(n.name, n)
+    return table
+
+
+def _decorator_is_root(dec: ast.AST) -> bool:
+    chain = attr_chain(dec if not isinstance(dec, ast.Call) else dec.func)
+    if chain and chain[-1] in _DECORATOR_ROOTS:
+        return True
+    # @functools.partial(jax.custom_vjp, ...) / @partial(jit, ...)
+    if isinstance(dec, ast.Call) and call_name(dec) == "partial" \
+            and dec.args:
+        inner = attr_chain(dec.args[0])
+        return bool(inner) and inner[-1] in _DECORATOR_ROOTS
+    return False
+
+
+def _roots(tree: ast.Module, table: Dict[str, ast.FunctionDef]
+           ) -> Set[str]:
+    roots: Set[str] = set()
+    for fn in table.values():
+        if any(_decorator_is_root(d) for d in fn.decorator_list):
+            roots.add(fn.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        is_defvjp = (isinstance(node.func, ast.Attribute)
+                     and node.func.attr in ("defvjp", "defjvp"))
+        if name not in _TRANSFORMS and not is_defvjp:
+            continue
+        args = list(node.args)
+        # functools.partial(kernel, ...) as a pallas_call argument
+        for a in list(args):
+            if isinstance(a, ast.Call) and call_name(a) == "partial":
+                args.extend(a.args)
+        for a in args:
+            if isinstance(a, ast.Name) and a.id in table:
+                roots.add(a.id)
+    return roots
+
+
+def _calls(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            out.add(n.func.id)
+        elif isinstance(n, ast.Name):
+            # a bare reference (closure capture, callback arg) keeps the
+            # callee reachable too
+            out.add(n.id)
+    return out
+
+
+def check_module(tree: ast.Module, path: str) -> List[Finding]:
+    table = _function_table(tree)
+    host = _host_modules(tree)
+    if not table:
+        return []
+    reachable = set()
+    frontier = list(_roots(tree, table))
+    while frontier:
+        name = frontier.pop()
+        if name in reachable or name not in table:
+            continue
+        reachable.add(name)
+        frontier.extend(_calls(table[name]) & set(table))
+
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for name in sorted(reachable):
+        fn = table[name]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                if node.lineno not in seen:
+                    seen.add(node.lineno)
+                    findings.append(Finding(
+                        "APX402", path, node.lineno,
+                        f"'global {', '.join(node.names)}' inside "
+                        f"'{name}', which is reachable from a traced "
+                        "body — trace-time global mutation is baked in "
+                        "as a constant"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[0] not in host:
+                continue
+            root = host[chain[0]]
+            full = [root] + chain[1:]
+            bad = (
+                root == "time"
+                or root == "random"
+                or root == "numpy.random"
+                or (root == "numpy" and len(full) > 1
+                    and full[1] == "random")
+                or (root == "datetime" and full[-1] in ("now", "today",
+                                                        "utcnow"))
+            )
+            if bad and node.lineno not in seen:
+                seen.add(node.lineno)
+                findings.append(Finding(
+                    "APX401", path, node.lineno,
+                    f"host-state read '{'.'.join(chain)}' inside "
+                    f"'{name}', which is reachable from a traced body — "
+                    "the value is frozen at trace time"))
+    return findings

@@ -1161,6 +1161,24 @@ def bench_kernel_parity(on_tpu, quiet=False):
 
     check("flash_dropout", 5e-2, fad(True), fad(False), q, k, v)
 
+    # dropout composed with a padding mask and causality: the keep mask
+    # and the -inf mask interact in the kernel's tile loop (a fully
+    # masked-out row must not be rescaled by 1/keep_prob into NaNs), so
+    # the combined branch gets its own compiled parity gate
+    def fadm(uk):
+        def g(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, pad_mask, causal=True, use_kernel=uk,
+                    dropout_rate=0.3,
+                    dropout_rng=jax.random.PRNGKey(7),
+                ).astype(jnp.float32) ** 2)
+            l, grads = jax.value_and_grad(loss, (0, 1, 2))(q, k, v)
+            return (l, *grads)
+        return g
+
+    check("flash_dropout_masked", 5e-2, fadm(True), fadm(False), q, k, v)
+
     # VPU-diet pinning: the shipped kernels (exp2 online softmax + bf16
     # p-tiles) vs the SAME kernels traced under the legacy toggles.
     # Catches a compiled-Mosaic divergence between the variants that the

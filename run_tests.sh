@@ -12,6 +12,10 @@
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
 #                           #     fp32 golden curve — run on every PR
+#   ./run_tests.sh lint     # apxlint static contract checks (kernel
+#                           #     aliasing, VMEM budgets, collectives,
+#                           #     AMP lists, tracer hygiene) — blocking
+#                           #     in CI, <30s on CPU
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -26,6 +30,7 @@ case "$tier" in
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
-  *)     echo "usage: $0 [L0|L1|all|quick|gate] [pytest args...]" >&2
+  lint)  exec python -m apex_tpu.lint apex_tpu tests "$@" ;;
+  *)     echo "usage: $0 [L0|L1|all|quick|gate|lint] [pytest args...]" >&2
          exit 2 ;;
 esac

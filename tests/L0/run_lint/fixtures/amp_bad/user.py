@@ -1,0 +1,19 @@
+# apxlint: fixture
+# Known-bad wiring: 'bmm' is intercepted but listed nowhere (APX302);
+# the 'linear' call makes amp_bad/lists.py's UNWIRED entry stale.
+from apex_tpu.amp.autocast import cast_args
+
+
+def matmul(a, b):
+    a, b = cast_args("matmul", a, b)
+    return a @ b
+
+
+def linear(x, w):
+    x, w = cast_args("linear", x, w)
+    return x @ w
+
+
+def bmm(a, b):
+    a, b = cast_args("bmm", a, b)
+    return a @ b

@@ -1,0 +1,10 @@
+# apxlint: fixture
+# Known-clean policy module: disjoint lists, every op either wired in
+# user.py or declared UNWIRED.
+FP16_FUNCS = frozenset({"matmul"})
+
+FP32_FUNCS = frozenset({"softmax"})
+
+CASTS = frozenset({"add"})
+
+UNWIRED = frozenset({"softmax", "add"})

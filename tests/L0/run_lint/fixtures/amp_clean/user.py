@@ -1,0 +1,8 @@
+# apxlint: fixture
+# Known-clean wiring for amp_clean/lists.py.
+from apex_tpu.amp.autocast import cast_args
+
+
+def matmul(a, b):
+    a, b = cast_args("matmul", a, b)
+    return a @ b

@@ -1,0 +1,25 @@
+# apxlint: fixture
+# Known-bad: _k writes m_out from m_ref (same stem) but the call only
+# aliases operand 1 (x) — the missing {2: 1} entry must raise APX101.
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _k(sc_ref, x_ref, m_ref, x_out, m_out):
+    x_out[:] = x_ref[:] * sc_ref[0, 0]
+    m_out[:] = m_ref[:] + x_ref[:]
+
+
+def step(sc, x, m):
+    spec = pl.BlockSpec((256, 128), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _k,
+        grid=(4,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   jax.ShapeDtypeStruct(m.shape, m.dtype)],
+        input_output_aliases={1: 0},
+    )(sc, x, m)

@@ -1,0 +1,32 @@
+# apxlint: fixture
+# Known-bad: online-softmax statistics dropped to bf16 three ways —
+# a bf16 m scratch tile, a bf16 lse output, and a store into l_ref that
+# rounds through astype(bfloat16). Each must raise APX103.
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fwd(q_ref, k_ref, o_ref, lse_ref, m_ref, l_ref):
+    m_ref[:] = jnp.maximum(m_ref[:], q_ref[:].max())
+    l_ref[:] = (l_ref[:] + q_ref[:].sum()).astype(jnp.bfloat16)
+    o_ref[:] = q_ref[:]
+    lse_ref[:] = m_ref[:] + jnp.log(l_ref[:])
+
+
+def attend(q, k):
+    spec = pl.BlockSpec((128, 64), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _fwd,
+        grid=(4,),
+        in_specs=[spec, spec],
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((q.shape[0], 128), jnp.bfloat16)),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16),
+                        pltpu.VMEM((128, 128), jnp.float32)],
+    )(q, k)

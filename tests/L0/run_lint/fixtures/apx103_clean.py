@@ -1,0 +1,30 @@
+# apxlint: fixture
+# Known-clean twin of apx103_bad: stats stay fp32 end to end; the bf16
+# cast on the probability tile (not a stats ref) is allowed.
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fwd(q_ref, k_ref, o_ref, lse_ref, m_ref, l_ref):
+    m_ref[:] = jnp.maximum(m_ref[:], q_ref[:].max())
+    l_ref[:] = l_ref[:] + q_ref[:].sum()
+    p = jnp.exp(q_ref[:]).astype(jnp.bfloat16)
+    o_ref[:] = p.astype(q_ref.dtype)
+    lse_ref[:] = m_ref[:] + jnp.log(l_ref[:])
+
+
+def attend(q, k):
+    spec = pl.BlockSpec((128, 64), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _fwd,
+        grid=(4,),
+        in_specs=[spec, spec],
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((q.shape[0], 128), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32),
+                        pltpu.VMEM((128, 128), jnp.float32)],
+    )(q, k)

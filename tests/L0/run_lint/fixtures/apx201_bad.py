@@ -1,0 +1,22 @@
+# apxlint: fixture
+# Known-bad: the psum only happens on shard 0 — every other shard skips
+# its side of the collective and the mesh deadlocks. Must raise APX201.
+import jax
+from jax import lax
+
+
+def rank_divergent_reduce(x):
+    if lax.axis_index("data") == 0:
+        x = lax.psum(x, "data")
+    return x
+
+
+def rank_reordered_collectives(x, y):
+    rank = lax.axis_index("data")
+    if rank == 0:
+        x = lax.psum(x, "data")
+        y = lax.ppermute(y, "data", [(0, 1)])
+    else:
+        y = lax.ppermute(y, "data", [(0, 1)])
+        x = lax.psum(x, "data")
+    return x, y

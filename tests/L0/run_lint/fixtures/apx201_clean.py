@@ -1,0 +1,21 @@
+# apxlint: fixture
+# Known-clean: rank-dependent branches issue the SAME collective
+# sequence (only the payload differs), and a config-static branch may
+# diverge freely — neither raises APX201.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def rank_dependent_payload(x):
+    if lax.axis_index("data") == 0:
+        y = lax.psum(x * 2.0, "data")
+    else:
+        y = lax.psum(jnp.zeros_like(x), "data")
+    return y
+
+
+def config_dependent_reduce(x, use_mean):
+    if use_mean:
+        return lax.pmean(x, "data")
+    return x

@@ -1,0 +1,9 @@
+# apxlint: fixture
+# Known-bad: "tensor" is not a mesh axis declared by parallel_state
+# (the real axes are data/pipe/context/model) nor by any local Mesh.
+# Must raise APX202.
+from jax import lax
+
+
+def reduce_over_typo_axis(x):
+    return lax.psum(x, "tensor")

@@ -1,0 +1,16 @@
+# apxlint: fixture
+# Known-clean: "data"/"model" are parallel_state axes; "rows" is
+# declared by a local Mesh in this module.
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+
+def reduce_over_known_axes(x):
+    x = lax.psum(x, "data")
+    return lax.pmean(x, "model")
+
+
+def local_mesh(devices, x):
+    with Mesh(devices, ("rows",)):
+        return lax.psum(x, "rows")

@@ -1,0 +1,18 @@
+# apxlint: fixture
+# Known-bad: a jit-traced body reads host state — time.time() and an
+# np.random draw are frozen into the compiled program at trace time.
+# Both reads must raise APX401 (the helper is reachable from the root).
+import time
+
+import jax
+import numpy as np
+
+
+def _noise(x):
+    return x + np.random.rand()
+
+
+@jax.jit
+def stamped_step(x):
+    t = time.time()
+    return _noise(x) * t

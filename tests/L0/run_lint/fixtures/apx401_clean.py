@@ -1,0 +1,17 @@
+# apxlint: fixture
+# Known-clean: host-state reads in plain host code (not reachable from
+# any traced root) are fine, and `from jax import random` must not be
+# mistaken for the stdlib random module.
+import time
+
+import jax
+from jax import random
+
+
+def host_timer():
+    return time.time()
+
+
+@jax.jit
+def step(key, x):
+    return x + random.normal(key, x.shape)

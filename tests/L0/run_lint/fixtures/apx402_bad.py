@@ -1,0 +1,24 @@
+# apxlint: fixture
+# Known-bad: a custom_vjp forward rule mutates a module global — the
+# mutation happens once at trace time, not per step. Must raise APX402.
+import jax
+
+_CALLS = 0
+
+
+@jax.custom_vjp
+def f(x):
+    return x * 2.0
+
+
+def _fwd(x):
+    global _CALLS
+    _CALLS += 1
+    return f(x), x
+
+
+def _bwd(res, g):
+    return (2.0 * g,)
+
+
+f.defvjp(_fwd, _bwd)

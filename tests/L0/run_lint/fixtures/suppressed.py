@@ -1,0 +1,15 @@
+# apxlint: fixture
+# The same violation as apx401_bad, silenced both ways the engine
+# supports: an inline trailing comment and a standalone comment line
+# directly above the flagged statement. Must lint clean.
+import time
+
+import jax
+
+
+@jax.jit
+def stamped(x):
+    t = time.time()  # apxlint: disable=APX401
+    # apxlint: disable=APX401
+    u = time.time()
+    return x * t * u

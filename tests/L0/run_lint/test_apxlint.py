@@ -1,0 +1,136 @@
+"""apxlint fixture tests: every error code must fire on its known-bad
+fixture and stay silent on the known-clean twin, suppression comments
+must work, and — the meta-test — the repo itself must lint clean."""
+
+import os
+
+import pytest
+
+from apex_tpu.lint import CODES
+from apex_tpu.lint.engine import lint_paths
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _codes(*names, **kw):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    findings, n = lint_paths(paths, trace=False, **kw)
+    assert n == len(paths) or kw.get("include_fixtures"), \
+        f"fixture file(s) not linted: {paths}"
+    return [f.code for f in findings]
+
+
+def test_codes_registry_complete():
+    assert set(CODES) == {
+        "APX100", "APX101", "APX102", "APX103",
+        "APX201", "APX202",
+        "APX301", "APX302", "APX303", "APX304",
+        "APX401", "APX402",
+    }
+    assert all(CODES[c] for c in CODES)  # every code documented
+
+
+def test_apx101_missing_alias():
+    assert _codes("apx101_bad.py") == ["APX101"]
+    assert _codes("apx101_clean.py") == []
+
+
+def test_apx103_stats_precision():
+    codes = _codes("apx103_bad.py")
+    # bf16 m scratch, bf16 lse output, downcast store into l_ref
+    assert codes.count("APX103") == 3, codes
+    assert _codes("apx103_clean.py") == []
+
+
+def test_apx201_collective_divergence():
+    codes = _codes("apx201_bad.py")
+    assert codes.count("APX201") == 2, codes
+    assert _codes("apx201_clean.py") == []
+
+
+def test_apx202_unknown_axis():
+    assert _codes("apx202_bad.py") == ["APX202"]
+    assert _codes("apx202_clean.py") == []
+
+
+def test_apx401_host_state_read():
+    codes = _codes("apx401_bad.py")
+    assert codes.count("APX401") == 2, codes  # time.time + np.random
+    assert _codes("apx401_clean.py") == []
+
+
+def test_apx402_global_write():
+    assert _codes("apx402_bad.py") == ["APX402"]
+
+
+def test_suppression_comments():
+    assert _codes("suppressed.py") == []
+
+
+def test_amp_list_coherence():
+    findings, _ = lint_paths([os.path.join(FIXTURES, "amp_bad")],
+                             trace=False, include_fixtures=True)
+    codes = sorted(f.code for f in findings)
+    assert codes == ["APX301", "APX302", "APX303", "APX304"], codes
+    by_code = {f.code: f for f in findings}
+    assert "matmul" in by_code["APX301"].message
+    assert "bmm" in by_code["APX302"].message
+    assert by_code["APX302"].path.endswith("user.py")
+    assert "softmax" in by_code["APX303"].message
+    assert "linear" in by_code["APX304"].message
+
+    clean, _ = lint_paths([os.path.join(FIXTURES, "amp_clean")],
+                          trace=False, include_fixtures=True)
+    assert clean == []
+
+
+def test_fixture_files_skipped_in_directory_walks():
+    findings, n = lint_paths([FIXTURES], trace=False)
+    assert n == 0 and findings == []
+
+
+def test_apx102_vmem_budget():
+    jax = pytest.importorskip("jax")
+    from apex_tpu.lint import vmem
+
+    def build():
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        def fn(x):
+            spec = pl.BlockSpec((4096, 1024), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)
+            return pl.pallas_call(
+                kernel, grid=(2,), in_specs=[spec], out_specs=spec,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+
+        return fn, (jax.ShapeDtypeStruct((8192, 1024), "float32"),)
+
+    # 4096x1024 fp32 block = 16 MiB; doubled in+out = 64 MiB >> budget.
+    findings = vmem.run_configs(
+        [vmem.Config("oversized", "apex_tpu.lint.vmem", build)])
+    assert [f.code for f in findings] == ["APX102"]
+    assert "oversized" in findings[0].message
+
+    # An untraceable config is APX100, not a silent pass.
+    def broken():
+        raise RuntimeError("boom")
+
+    findings = vmem.run_configs(
+        [vmem.Config("broken", "apex_tpu.lint.vmem", broken)])
+    assert [f.code for f in findings] == ["APX100"]
+
+
+def test_repo_lints_clean():
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    findings, n_files = lint_paths(
+        [os.path.join(repo, "apex_tpu"), os.path.join(repo, "tests")],
+        trace=True)
+    assert n_files > 100
+    assert findings == [], "\n".join(f.render() for f in findings)
