@@ -44,9 +44,10 @@ CASTS = frozenset({
 # an op below gains a call site — remove it from this set as it gets
 # wired.
 UNWIRED = frozenset({
-    # FP16_FUNCS not yet routed through cast_args (wired: dense, conv2d)
+    # FP16_FUNCS not yet routed through cast_args
+    # (wired: dense, conv2d, matmul, einsum)
     "conv1d", "conv3d", "conv_transpose2d",
-    "matmul", "dot", "dot_general", "einsum", "linear",
+    "dot", "dot_general", "linear",
     "bmm", "mm", "mv", "addmm", "addbmm", "baddbmm",
     "attention_qk", "attention_av",
     # FP32_FUNCS

@@ -47,6 +47,21 @@ CODES = {
               "function reachable from a jit/custom_vjp/kernel body",
     "APX402": "global-statement write in a function reachable from a "
               "jit/custom_vjp/kernel body",
+    "APX501": "traced program accumulates (reduce_sum/cumsum/scan "
+              "carry add) on a sub-fp32 operand — reductions must run "
+              "on an fp32 accumulator",
+    "APX502": "amp train step writes optimizer state not dominated by "
+              "the loss-scale division and the overflow check "
+              "(missing unscale or unguarded update)",
+    "APX503": "traced equation materializes an intermediate more than "
+              "8x larger than its operands (broadcast/one-hot/score-"
+              "matrix blowup)",
+    "APX511": "per-rank simulation of a shard_map body yields "
+              "divergent collective schedules or a malformed ppermute "
+              "(multi-chip deadlock)",
+    "APX512": "declared input_output_aliases pair does not survive "
+              "into the traced jaxpr (severed provenance, dtype/shape "
+              "mismatch, or dropped pair) — HBM traffic doubles",
 }
 
 

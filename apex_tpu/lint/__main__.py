@@ -3,7 +3,9 @@
 Exit status is 0 when every check passes, 1 when any finding survives
 suppression — suitable as a blocking CI step. ``--no-trace`` skips the
 trace-time VMEM budget pass (APX102) for a pure-AST run that needs no
-jax import; ``--select`` narrows to a comma-separated code list.
+jax import; ``--trace`` additionally runs the jaxpr-level trace tier
+(APX501/502/503/511/512) over the ``apex_tpu.lint.traced`` entry
+registry; ``--select`` narrows to a comma-separated code list.
 """
 
 import argparse
@@ -23,6 +25,9 @@ def main(argv=None) -> int:
                          "(default: apex_tpu)")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the trace-time VMEM budget pass (APX102)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also run the jaxpr trace tier (APX5xx) over "
+                         "the registered entrypoints")
     ap.add_argument("--select", default=None, metavar="CODES",
                     help="comma-separated codes to report "
                          "(e.g. APX101,APX201)")
@@ -51,6 +56,7 @@ def main(argv=None) -> int:
     findings, n_files = lint_paths(paths,
                                    include_fixtures=args.include_fixtures,
                                    trace=not args.no_trace,
+                                   trace_registry=args.trace,
                                    select=select)
     for f in findings:
         print(f.render())

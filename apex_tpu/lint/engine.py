@@ -14,7 +14,13 @@ Checks come in two shapes:
 - project checks run once over the whole file set: ``amp_lists`` (needs
   the op-list module and every call site together) and ``vmem`` (the
   trace-time budget evaluation of the registered kernel configs,
-  skipped with ``trace=False``).
+  skipped with ``trace=False``);
+- the trace tier (``trace_registry=True`` / CLI ``--trace``) walks the
+  ``apex_tpu.lint.traced`` entry registry under ``jax.make_jaxpr`` and
+  runs the APX5xx jaxpr-level verifiers. Its findings land on the
+  traced module's file at line 1 and pass through the same suppression
+  machinery (use ``# apxlint: disable-file=CODE`` — trace findings have
+  no meaningful source line).
 """
 
 import ast
@@ -26,6 +32,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from apex_tpu.lint import CODES, Finding
 
 _SUPPRESS_RE = re.compile(r"#\s*apxlint:\s*disable=([A-Z0-9,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*apxlint:\s*disable-file=([A-Z0-9,\s]+)")
 _FIXTURE_RE = re.compile(r"#\s*apxlint:\s*fixture")
 _SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".pytest_cache",
               "build", "dist"}
@@ -80,6 +88,26 @@ def parse_suppressions(src: str) -> Dict[int, Set[str]]:
     return sup
 
 
+def parse_file_suppressions(src: str) -> Set[str]:
+    """Codes suppressed for the whole file.
+
+    ``# apxlint: disable-file=CODE[,CODE...]`` on any comment-only line
+    (conventionally the module header) suppresses those codes at every
+    line of the file — the shape needed for trace-tier findings, which
+    are attributed to the traced module at line 1 rather than to the
+    specific equation's source line.
+    """
+    out: Set[str] = set()
+    for line in src.splitlines():
+        if not line.lstrip().startswith("#"):
+            continue
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            out.update(c.strip() for c in m.group(1).split(",")
+                       if c.strip())
+    return out
+
+
 def _read(path: str) -> Optional[str]:
     try:
         with tokenize.open(path) as fh:  # honors PEP 263 encodings
@@ -89,7 +117,7 @@ def _read(path: str) -> Optional[str]:
 
 
 def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
-               trace: bool = True,
+               trace: bool = True, trace_registry: bool = False,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
     """Run all checks over ``paths``; returns (findings, files_checked)."""
@@ -117,9 +145,18 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
             findings.extend(checker.check_module(tree, path))
 
     findings.extend(amp_lists.check_files(trees))
+    if trace or trace_registry:
+        # must precede first backend touch: the sharded entries (vmem's
+        # bottleneck config, the trace tier's mesh entries) need the
+        # 8-device CPU world
+        from apex_tpu.lint.traced.registry import ensure_cpu_devices
+        ensure_cpu_devices()
     if trace:
         from apex_tpu.lint import vmem
         findings.extend(vmem.check_repo())
+    if trace_registry:
+        from apex_tpu.lint import traced
+        findings.extend(traced.check_repo())
 
     findings = _apply_suppressions(findings, sources)
     if select is not None:
@@ -132,14 +169,20 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
 def _apply_suppressions(findings: List[Finding],
                         sources: Dict[str, str]) -> List[Finding]:
     by_file: Dict[str, Dict[int, Set[str]]] = {}
+    file_wide: Dict[str, Set[str]] = {}
     out = []
     for f in findings:
         if f.code not in CODES:
             raise ValueError(f"checker emitted unregistered code {f.code}")
-        if f.path not in by_file and f.path in sources:
-            by_file[f.path] = parse_suppressions(sources[f.path])
-        sup = by_file.get(f.path, {})
-        if f.code in sup.get(f.line, ()):
+        if f.path not in by_file:
+            src = sources.get(f.path)
+            if src is None:  # trace-tier path outside the linted set
+                src = _read(f.path) or ""
+            by_file[f.path] = parse_suppressions(src)
+            file_wide[f.path] = parse_file_suppressions(src)
+        if f.code in file_wide.get(f.path, ()):
+            continue
+        if f.code in by_file.get(f.path, {}).get(f.line, ()):
             continue
         out.append(f)
     return out

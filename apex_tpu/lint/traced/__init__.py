@@ -1,0 +1,24 @@
+"""apxlint trace tier — jaxpr-level verifiers (APX5xx).
+
+The AST tier (``apex_tpu.lint.checks``) sees source; this tier sees
+*programs*. A registry of traceable entrypoints (``registry.py``) is
+walked under ``jax.make_jaxpr`` — abstract shapes only, no compile, no
+accelerator — and each traced jaxpr is handed to the verifiers:
+
+- ``precision``  — APX501 sub-fp32 reduction/loop accumulators,
+                   APX502 loss-scale unscale/overflow-check placement;
+- ``memory``     — APX503 broadcast/materialization blowup;
+- ``schedule``   — APX511 per-rank SPMD collective-schedule simulation;
+- ``aliases``    — APX512 declared ``input_output_aliases`` survival.
+
+Run via ``python -m apex_tpu.lint --trace``. Import side effects are
+kept minimal: jax is only imported when a check actually runs.
+"""
+
+from apex_tpu.lint.traced.registry import (  # noqa: F401
+    TraceEntry,
+    check_repo,
+    ensure_cpu_devices,
+    repo_entries,
+    run_entries,
+)

@@ -1,0 +1,291 @@
+"""APX501/APX502 — jaxpr-level precision-flow verifiers.
+
+APX501 (reduction accumulators) is the dynamic complement of the
+AST-only APX103: instead of pattern-matching stats-named tiles in
+source, it walks the *traced* program — through ``scan``/``cond``/
+``pjit`` sub-jaxprs and Pallas kernel bodies — and flags any summing
+reduction whose operand is a sub-fp32 float. A bf16 ``reduce_sum`` over
+more than a few hundred elements loses mantissa bits every step (bf16
+has 8); the mixed-precision recipe (Micikevicius et al., 2018) keeps
+all accumulations fp32. ``dot_general``/``conv`` are exempt — the MXU
+accumulates fp32 internally regardless of operand dtype — and so are
+order-insensitive reductions (max/min/and/or). A scan whose *carry* is
+a sub-fp32 float updated by an ``add`` on the carried value is the same
+bug spelled as a loop (a bf16 gradient accumulator), and is flagged too.
+
+APX502 (unscale/overflow-check placement) is a forward taint
+interpreter over the traced amp step. Abstract tags per variable:
+
+- ``scale``    — data-derived from the loss-scale scalar (the entry's
+  first flat input): the scaled loss, the gradients of the scaled loss,
+  anything computed from them;
+- ``unscaled`` — passed through a division by a scale-tainted value
+  (``1/loss_scale`` then multiply, or a direct divide);
+- ``finite``   — derived from an ``is_finite`` reduction (the overflow
+  flag);
+- ``guarded``  — selected by a ``select_n`` whose *predicate* is
+  finite-tainted (``apply_if_finite`` / ``select_finite``).
+
+The two contract checks over the entry's declared optimizer-state
+outputs: every state write influenced by traced inputs must be
+``guarded`` (the overflow check dominates the write), and no state
+write may carry ``scale`` without ``unscaled`` (the loss-scale division
+dominates the write). Predicate tags are deliberately *not* unioned
+into ``select_n``'s data tags, so the step counter selected by the
+overflow flag does not spuriously inherit ``scale``.
+"""
+
+from typing import List, Sequence, Set
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.traced import jaxprlib as jl
+
+# Reductions that accumulate (order- and precision-sensitive).
+_SUM_REDUCES = {
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+    "reduce_window_sum",
+}
+
+_ACCUM_PRIMS = {"add", "add_any"}
+
+# Minimum per-output accumulation length before a sub-fp32 reduction is
+# flagged. bf16 carries 8 mantissa bits, so magnitude-1 contributions
+# stop registering after a few hundred additions; below this length the
+# error is bounded and ubiquitous (every bias wgrad in a bf16 backward
+# is a short bf16 reduce_sum) — flagging those would force fp32 casts
+# that change nothing.
+_MIN_ACCUM = 512
+
+
+def _accum_length(eqn, operand) -> int:
+    """Elements folded into each output of a summing reduction."""
+    name = eqn.primitive.name
+    shape = getattr(operand.aval, "shape", ())
+    if name in ("cumsum", "cumprod", "cumlogsumexp"):
+        axis = eqn.params.get("axis")
+        if axis is not None and shape:
+            return int(shape[axis])
+        return max([int(d) for d in shape] or [1])
+    in_elems = 1
+    for d in shape:
+        in_elems *= int(d)
+    out_elems = 1
+    for d in getattr(eqn.outvars[0].aval, "shape", ()):
+        out_elems *= int(d)
+    return in_elems // max(out_elems, 1)
+
+
+# ---------------------------------------------------------------------------
+# APX501 — sub-fp32 reduction / scan-carried accumulator
+# ---------------------------------------------------------------------------
+
+def check_reductions(closed, path: str, entry: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for eqn in jl.all_eqns(closed):
+        name = eqn.primitive.name
+        if name in _SUM_REDUCES:
+            for v in eqn.invars:
+                if jl.is_literal(v) or not jl.is_sub_fp32(v.aval):
+                    continue
+                length = _accum_length(eqn, v)
+                if length < _MIN_ACCUM:
+                    continue
+                dtype = v.aval.dtype
+                findings.append(Finding(
+                    "APX501", path, 1,
+                    f"entry '{entry}': {name} folds {length} {dtype} "
+                    f"elements (operand shape {tuple(v.aval.shape)}) "
+                    f"into each output — reductions of this length "
+                    f"must run on an fp32 (or wider) accumulator"))
+        elif name == "scan":
+            findings.extend(_check_scan_carry(eqn, path, entry))
+    return findings
+
+
+def _depends_on(var, target, producers, _cache=None) -> bool:
+    """Does ``var`` transitively depend on ``target`` inside one body?
+
+    Equations are treated as opaque (any tainted invar taints every
+    outvar), which is conservative through nested pjit/remat calls.
+    """
+    if _cache is None:
+        _cache = {}
+    stack, seen = [var], set()
+    while stack:
+        v = stack.pop()
+        if v is target:
+            return True
+        if jl.is_literal(v) or id(v) in seen:
+            continue
+        seen.add(id(v))
+        eqn = producers.get(v)
+        if eqn is not None:
+            stack.extend(eqn.invars)
+    return False
+
+
+def _check_scan_carry(eqn, path: str, entry: str) -> List[Finding]:
+    body = jl.open_jaxpr(eqn.params["jaxpr"])
+    nc = eqn.params.get("num_consts", 0)
+    ncar = eqn.params.get("num_carry", 0)
+    findings: List[Finding] = []
+    producers = {ov: e for e in body.eqns for ov in e.outvars}
+    for i in range(ncar):
+        carry_in = body.invars[nc + i]
+        if not jl.is_sub_fp32(carry_in.aval):
+            continue
+        carry_out = body.outvars[i]
+        prod = producers.get(carry_out)
+        if prod is None or prod.primitive.name not in _ACCUM_PRIMS:
+            continue
+        operands = [v for v in prod.invars if not jl.is_literal(v)]
+        if carry_in not in operands:
+            continue
+        # residual discriminator: ``x + f(x)`` (the other addend derives
+        # from the carry) is a per-step residual, not an accumulator —
+        # only ``acc + g(xs)`` with g independent of the carry compounds
+        # rounding error every iteration
+        others = [v for v in operands if v is not carry_in]
+        if others and all(_depends_on(v, carry_in, producers)
+                          for v in others):
+            continue
+        findings.append(Finding(
+            "APX501", path, 1,
+            f"entry '{entry}': scan carries a "
+            f"{carry_in.aval.dtype} accumulator of shape "
+            f"{tuple(carry_in.aval.shape)} updated by "
+            f"{prod.primitive.name} — loop-carried accumulation "
+            f"must be fp32 (fp32_grad_accum)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APX502 — taint propagation
+# ---------------------------------------------------------------------------
+
+_FIXPOINT_CAP = 8
+
+
+def _read(env, v) -> Set[str]:
+    if jl.is_literal(v):
+        return set()
+    return env.get(v, set())
+
+
+def _prop(jaxpr_like, in_tags: Sequence[Set[str]]) -> List[Set[str]]:
+    """Forward tag propagation through one (possibly closed) jaxpr."""
+    jaxpr = jl.open_jaxpr(jaxpr_like)
+    env = {}
+    for v, t in zip(jaxpr.invars, in_tags):
+        env[v] = set(t)
+    for v in jaxpr.constvars:
+        env[v] = set()
+    for eqn in jaxpr.eqns:
+        outs = _prop_eqn(eqn, [_read(env, v) for v in eqn.invars])
+        for ov, t in zip(eqn.outvars, outs):
+            env[ov] = t
+    return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _prop_eqn(eqn, in_t: List[Set[str]]) -> List[Set[str]]:
+    name = eqn.primitive.name
+    n_out = len(eqn.outvars)
+
+    if name == "scan":
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        consts, carry = in_t[:nc], [set(t) for t in in_t[nc:nc + ncar]]
+        xs = in_t[nc + ncar:]
+        out = [set() for _ in range(n_out)]
+        for _ in range(_FIXPOINT_CAP):
+            out = _prop(eqn.params["jaxpr"], consts + carry + xs)
+            new_carry = [c | o for c, o in zip(carry, out[:ncar])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        return carry + [set(t) for t in out[ncar:]]
+
+    if name == "while":
+        cc = eqn.params.get("cond_nconsts", 0)
+        bc = eqn.params.get("body_nconsts", 0)
+        body_consts = in_t[cc:cc + bc]
+        carry = [set(t) for t in in_t[cc + bc:]]
+        for _ in range(_FIXPOINT_CAP):
+            out = _prop(eqn.params["body_jaxpr"], body_consts + carry)
+            new_carry = [c | o for c, o in zip(carry, out)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        return carry
+
+    if name == "cond":
+        ops = in_t[1:]
+        merged = [set() for _ in range(n_out)]
+        for branch in eqn.params["branches"]:
+            for acc, t in zip(merged, _prop(branch, ops)):
+                acc |= t
+        return merged
+
+    # generic sub-jaxpr call (pjit, remat, shard_map, custom_vjp, ...):
+    # recurse when the arity matches; pallas_call's kernel jaxpr takes
+    # refs for outputs too, so it falls through to the union rule.
+    for _, sub in jl.sub_jaxprs(eqn):
+        sj = jl.open_jaxpr(sub)
+        if (len(sj.invars) == len(eqn.invars)
+                and len(sj.outvars) == n_out):
+            return [set(t) for t in _prop(sub, in_t)]
+
+    base: Set[str] = set()
+    for t in in_t:
+        base |= t
+
+    if name == "div" and len(in_t) >= 2 and "scale" in in_t[1]:
+        base = base | {"unscaled"}
+    elif name == "is_finite":
+        base = base | {"finite"}
+    elif name == "select_n" and in_t:
+        pred = in_t[0]
+        base = set()
+        for t in in_t[1:]:
+            base |= t
+        if "finite" in pred or "guarded" in pred:
+            base |= {"guarded"}
+    return [set(base) for _ in range(n_out)]
+
+
+def check_amp(closed, path: str, entry: str,
+              n_protected: int) -> List[Finding]:
+    """Contract check over the entry's flat outputs.
+
+    Convention (enforced by the registry builders): the entry fn's first
+    flat input is the loss-scale scalar, and its first ``n_protected``
+    flat outputs are the optimizer-state writes (new params + optimizer
+    state).
+    """
+    jaxpr = closed.jaxpr
+    in_tags: List[Set[str]] = [set() for _ in jaxpr.invars]
+    if not in_tags:
+        return []
+    in_tags[0] = {"scale"}
+    out_tags = _prop(jaxpr, in_tags)
+    protected = out_tags[:n_protected]
+
+    findings: List[Finding] = []
+    unguarded = sum(1 for t in protected if t and "guarded" not in t)
+    if unguarded:
+        findings.append(Finding(
+            "APX502", path, 1,
+            f"entry '{entry}': {unguarded} of {n_protected} optimizer-"
+            f"state writes are not dominated by the overflow check (no "
+            f"finite-flag select guards the write — an inf/nan step is "
+            f"applied instead of skipped)"))
+    scaled = sum(1 for t in protected
+                 if "scale" in t and "unscaled" not in t)
+    if scaled:
+        findings.append(Finding(
+            "APX502", path, 1,
+            f"entry '{entry}': {scaled} of {n_protected} optimizer-"
+            f"state writes consume loss-scaled gradients with no "
+            f"loss-scale division on the path (missing unscale — the "
+            f"update is wrong by the loss-scale factor)"))
+    return findings

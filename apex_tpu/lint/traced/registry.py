@@ -1,0 +1,600 @@
+"""Trace-tier entry registry and driver.
+
+A :class:`TraceEntry` names a *traceable entrypoint* — a representative
+invocation of a kernel, an optimizer, an amp-wrapped train step, or a
+parallel schedule — and the jaxpr-level verifiers to run over it. The
+driver traces each entry under ``jax.make_jaxpr`` (abstract only, no
+compile, CPU-safe) and dispatches to the APX5xx checkers; an entry that
+fails to trace at all is an APX100 finding, never a silent skip (same
+contract as the APX102 VMEM registry).
+
+Builder conventions:
+
+- ``build()`` returns ``(fn, args)`` where args are
+  ``jax.ShapeDtypeStruct`` trees — nothing is materialized;
+- entries with the ``amp`` check make ``fn``'s FIRST flat argument the
+  loss-scale scalar and return ``(protected_state, aux)`` where
+  ``protected_state`` is the tree of optimizer-state writes (new
+  params + optimizer state) — :func:`precision.check_amp` seeds and
+  reads taint by those positions;
+- entries that need the global mesh set ``mesh`` to a thunk calling
+  ``parallel_state.initialize_model_parallel``; the driver snapshots
+  and restores the parallel state around every entry.
+
+The registry needs the 8-virtual-device CPU world the test rig uses
+(pipeline/TP/context entries shard over it); ``ensure_cpu_devices``
+arranges that BEFORE first backend use, falling back to ``XLA_FLAGS``
+on older jax, and degrades to APX100 findings for mesh entries when the
+backend was already initialized too small.
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from apex_tpu.lint import Finding
+
+_DEFAULT_DEVICES = 8
+
+
+@dataclass
+class TraceEntry:
+    name: str
+    module: str  # dotted module whose contract this entry exercises
+    build: Callable[[], Tuple[Callable, tuple]]
+    checks: Tuple[str, ...] = ("precision", "memory")
+    mesh: Optional[Callable[[], None]] = None
+    min_devices: int = 1
+    min_alias_pairs: int = 0
+    blowup_factor: float = 8.0
+    blowup_floor: int = 1 << 20
+
+
+def ensure_cpu_devices(n: int = _DEFAULT_DEVICES) -> int:
+    """Best-effort: give this process an ``n``-device CPU world.
+
+    Only effective before the jax backend initializes (the lint CLI
+    calls it first thing; under pytest the conftest has already done
+    the equivalent). Afterwards it is a no-op and the caller sees the
+    actual device count.
+    """
+    import os
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backend already up; keep going
+        pass
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:  # noqa: BLE001 - older jax: XLA flag, read at init
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}")
+    return jax.device_count()
+
+
+def _snapshot_parallel_state():
+    from apex_tpu.transformer import parallel_state as ps
+
+    return (ps._MESH,
+            ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE,
+            ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK,
+            ps._PIPELINE_MODEL_PARALLEL_SPLIT_RANK)
+
+
+def _restore_parallel_state(snap) -> None:
+    from apex_tpu.transformer import parallel_state as ps
+
+    (ps._MESH,
+     ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE,
+     ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK,
+     ps._PIPELINE_MODEL_PARALLEL_SPLIT_RANK) = snap
+
+
+def _module_path(dotted: str) -> str:
+    import importlib
+
+    try:
+        return importlib.import_module(dotted).__file__ or dotted
+    except Exception:  # noqa: BLE001
+        return dotted
+
+
+def run_entries(entries: List[TraceEntry]) -> List[Finding]:
+    """Trace every entry and run its checks; APX100 on trace failure."""
+    ensure_cpu_devices()
+    import jax
+
+    from apex_tpu.lint.traced import aliases, memory, precision, schedule
+
+    findings: List[Finding] = []
+    for e in entries:
+        path = _module_path(e.module)
+        snap = _snapshot_parallel_state()
+        try:
+            try:
+                have = jax.device_count()
+                if have < e.min_devices:
+                    raise RuntimeError(
+                        f"needs {e.min_devices} devices, have {have} "
+                        f"(backend initialized before ensure_cpu_devices)")
+                if e.mesh is not None:
+                    e.mesh()
+                fn, args = e.build()
+                closed, out_shape = jax.make_jaxpr(
+                    fn, return_shape=True)(*args)
+            finally:
+                _restore_parallel_state(snap)
+        except Exception as exc:  # noqa: BLE001 - surfaced as a finding
+            findings.append(Finding(
+                "APX100", path, 1,
+                f"trace entry '{e.name}' failed to trace: "
+                f"{type(exc).__name__}: {exc}"))
+            continue
+
+        if "precision" in e.checks:
+            findings.extend(precision.check_reductions(closed, path, e.name))
+        if "amp" in e.checks:
+            prot = out_shape[0] if isinstance(out_shape, tuple) else out_shape
+            n_prot = len(jax.tree_util.tree_leaves(prot))
+            findings.extend(precision.check_amp(closed, path, e.name,
+                                                n_prot))
+        if "memory" in e.checks:
+            findings.extend(memory.check(closed, path, e.name,
+                                         factor=e.blowup_factor,
+                                         floor=e.blowup_floor))
+        if "schedule" in e.checks:
+            findings.extend(schedule.check(closed, path, e.name))
+        if "aliases" in e.checks:
+            findings.extend(aliases.check(
+                closed, path, e.name, min_alias_pairs=e.min_alias_pairs))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# registered repo entrypoints
+# ---------------------------------------------------------------------------
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def _flash_entry(d, dtype, seq):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.transformer.functional.flash_attention import (
+            flash_attention,
+        )
+
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal=True, use_kernel=True)
+            # squared so the cotangent is data-dependent, not a
+            # broadcast-of-ones (which would trip APX503 on the harness)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        fn = lambda q, k, v: jax.value_and_grad(loss, (0, 1, 2))(q, k, v)
+        shape = (1, 2, seq, d)
+        return fn, (_sds(shape, dtype),) * 3
+
+    return build
+
+
+def _ln_entry(h, rms=False):
+    def build():
+        import importlib
+
+        import jax
+        import jax.numpy as jnp
+
+        fln = importlib.import_module(
+            "apex_tpu.normalization.fused_layer_norm")
+
+        if rms:
+            def loss(x, w):
+                y = fln.fused_rms_norm_affine(x, w, (h,))
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+            args = (_sds((2048, h), "float32"), _sds((h,), "float32"))
+            return (lambda *a: jax.value_and_grad(loss, (0, 1))(*a)), args
+
+        def loss(x, w, b):
+            y = fln.fused_layer_norm_affine(x, w, b, (h,))
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        args = (_sds((2048, h), "float32"), _sds((h,), "float32"),
+                _sds((h,), "float32"))
+        return (lambda *a: jax.value_and_grad(loss, (0, 1, 2))(*a)), args
+
+    return build
+
+
+def _xentropy_entry():
+    def build():
+        import jax
+
+        from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+        def loss(logits, labels):
+            return softmax_cross_entropy_loss(logits, labels).mean()
+
+        fn = lambda lg, lb: jax.value_and_grad(loss)(lg, lb)
+        return fn, (_sds((1024, 512), "float32"), _sds((1024,), "int32"))
+
+    return build
+
+
+def _flat_entry(which):
+    rows = 8192  # aligned to the block multiple: no pad, alias survives
+
+    def build():
+        import functools as ft
+
+        from apex_tpu.multi_tensor_apply import kernels as K
+
+        buf = _sds((rows, 128), "float32")
+        m16 = _sds((rows, 128), "bfloat16")
+        ids = _sds((rows // 8,), "int32")
+        if which == "adam":
+            fn = ft.partial(K.flat_adam, lr=1e-3, beta1=0.9, beta2=0.99,
+                            eps=1e-8, step=1, weight_decay=0.01,
+                            interpret=True)
+            return fn, (buf, buf, buf, buf)
+        if which == "sgd":
+            fn = ft.partial(K.flat_sgd, lr=1e-3, momentum=0.9,
+                            dampening=0.0, weight_decay=0.0,
+                            nesterov=False, wd_after_momentum=False,
+                            first_run=True, interpret=True)
+            return fn, (buf, buf, m16)
+        if which == "lamb":
+            fn = ft.partial(K.flat_lamb, lr=1e-3, beta1=0.9, beta2=0.99,
+                            eps=1e-8, step=1, weight_decay=0.01,
+                            num_tensors=4, interpret=True)
+            return fn, (buf, buf, m16, buf, ids)
+        if which == "adagrad":
+            fn = ft.partial(K.flat_adagrad, lr=1e-3, eps=1e-8,
+                            weight_decay=0.0, interpret=True)
+            return fn, (buf, buf, buf)
+        fn = ft.partial(K.flat_novograd, lr=1e-3, beta1=0.9,
+                        beta2=0.99, eps=1e-8, step=1, weight_decay=0.0,
+                        num_tensors=4, interpret=True)
+        return fn, (buf, buf, m16, _sds((4,), "float32"), ids)
+
+    return build
+
+
+def _fused_adam_tree_entry():
+    def build():
+        import jax
+
+        from apex_tpu.optimizers.fused_adam import FusedAdam
+
+        opt = FusedAdam(lr=1e-3, use_flat_kernel=False)
+        params = {"w": _sds((256, 128), "float32"),
+                  "b": _sds((128,), "float32")}
+        state = jax.eval_shape(opt.init, params)
+
+        def step(grads, params, state):
+            return opt.step(grads, params, state)
+
+        return step, (params, params, state)
+
+    return build
+
+
+def _amp_o2_step_entry(model):
+    """O2 amp train step over a tiny model; the APX502 subject.
+
+    fn layout (the check_amp convention): first arg = loss-scale
+    scalar, first output = (new master params, new optimizer state).
+    """
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu import amp
+        from apex_tpu.amp.scaler import LossScalerState
+        from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam
+
+        h = amp.initialize("O2", verbosity=0, loss_scale="dynamic")
+        opt = FusedAdam(lr=1e-3, use_flat_kernel=False)
+
+        if model == "bert":
+            from apex_tpu.models.bert import (
+                apply_bert, bert_tiny, init_bert, mlm_loss,
+            )
+
+            cfg = bert_tiny()
+            master = jax.eval_shape(
+                lambda k: init_bert(k, cfg), jax.random.PRNGKey(0))
+            batch = {"ids": _sds((2, 32), "int32"),
+                     "labels": _sds((2, 32), "int32")}
+
+            def loss_fn(p, b):
+                out = apply_bert(p, cfg, b["ids"])
+                mask = jnp.ones_like(b["labels"], jnp.float32)
+                return mlm_loss(out["mlm_logits"], b["labels"], mask)
+        else:
+            from apex_tpu.models.gpt import (
+                gpt_loss_unsharded, gpt_tiny, init_gpt,
+            )
+
+            cfg = gpt_tiny()
+            master = jax.eval_shape(
+                lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+            batch = {"ids": _sds((2, 32), "int32"),
+                     "labels": _sds((2, 32), "int32")}
+
+            def loss_fn(p, b):
+                return gpt_loss_unsharded(p, cfg, b["ids"], b["labels"])
+
+        mstate = jax.eval_shape(opt.init, master)
+
+        def step(loss_scale, master, m, v, stepc, batch):
+            state = LossScalerState(
+                loss_scale=loss_scale,
+                unskipped=jnp.zeros((), jnp.int32),
+                overflows=jnp.zeros((), jnp.int32))
+            params = h.cast_model(master)
+            loss, grads, found_inf, new_state = h.value_and_grad(
+                loss_fn)(params, state, batch)
+            new_master, new_mstate = opt.step(
+                grads, master, AdamState(stepc, m, v),
+                found_inf=found_inf)
+            return (new_master, new_mstate), (loss, new_state.loss_scale)
+
+        args = (_sds((), "float32"), master, mstate.m, mstate.v,
+                _sds((), mstate.step.dtype), batch)
+        return step, args
+
+    return build
+
+
+# --- tiny pipeline harness (mirrors tests/L0/run_transformer) ---------------
+
+_PP_VOCAB, _PP_SEQ, _PP_HIDDEN, _PP_FF = 64, 8, 16, 32
+
+
+def _pp_model():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.pipeline_parallel import PipelineModel
+
+    def embed_fn(p, mb):
+        x = p["word"][mb["ids"]]
+        return x + p["pos"][None, : x.shape[1]]
+
+    def stage_fn(p, x):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        h = (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["ln_w"] + p["ln_b"]
+        h = jax.nn.gelu(h @ p["fc1"] + p["b1"]) @ p["fc2"] + p["b2"]
+        return x + h
+
+    def loss_fn(p, x, mb):
+        logits = x @ p["proj"] + p["bias"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, mb["labels"][..., None], -1)[..., 0]
+        return -ll.mean()
+
+    return PipelineModel(embed_fn, stage_fn, loss_fn)
+
+
+def _pp_args(n_stages, batch, stage_lead=()):
+    v, s, hd, ff = _PP_VOCAB, _PP_SEQ, _PP_HIDDEN, _PP_FF
+    params = {
+        "embed": {"word": _sds((v, hd), "float32"),
+                  "pos": _sds((s, hd), "float32")},
+        "stages": {
+            "ln_w": _sds(stage_lead + (n_stages, hd), "float32"),
+            "ln_b": _sds(stage_lead + (n_stages, hd), "float32"),
+            "fc1": _sds(stage_lead + (n_stages, hd, ff), "float32"),
+            "b1": _sds(stage_lead + (n_stages, ff), "float32"),
+            "fc2": _sds(stage_lead + (n_stages, ff, hd), "float32"),
+            "b2": _sds(stage_lead + (n_stages, hd), "float32"),
+        },
+        "head": {"proj": _sds((hd, v), "float32"),
+                 "bias": _sds((v,), "float32")},
+    }
+    mb = {"ids": _sds((batch, s), "int32"),
+          "labels": _sds((batch, s), "int32")}
+    return params, mb
+
+
+def _pp_1f1b_entry(pp, n_mb):
+    def build():
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer import parallel_state as ps
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_without_interleaving,
+        )
+
+        model = _pp_model()
+        params, mb = _pp_args(pp, 2 * n_mb)
+        tree_spec = {"embed": P(), "stages": P(ps.PIPE_AXIS), "head": P()}
+        fn = ps.shard_map(
+            lambda p, b: forward_backward_pipelining_without_interleaving(
+                model, p, b, num_microbatches=n_mb),
+            in_specs=(tree_spec, P()),
+            out_specs=(P(), tree_spec))
+        return fn, (params, mb)
+
+    return build
+
+
+def _pp_interleaved_entry(pp, vpp, n_mb):
+    def build():
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer import parallel_state as ps
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_pipelining_with_interleaving,
+        )
+
+        model = _pp_model()
+        params, mb = _pp_args(pp, 2 * n_mb, stage_lead=(vpp,))
+        tree_spec = {"embed": P(), "stages": P(None, ps.PIPE_AXIS),
+                     "head": P()}
+        fn = ps.shard_map(
+            lambda p, b: forward_backward_pipelining_with_interleaving(
+                model, p, b, num_microbatches=n_mb),
+            in_specs=(tree_spec, P()),
+            out_specs=(P(), tree_spec))
+        return fn, (params, mb)
+
+    return build
+
+
+def _pp_sequential_entry():
+    def build():
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_no_pipelining,
+        )
+
+        model = _pp_model()
+        params, mb = _pp_args(3, 4)
+        fn = lambda p, b: forward_backward_no_pipelining(
+            model, p, b, num_microbatches=2)
+        return fn, (params, mb)
+
+    return build
+
+
+def _tp_block_entry(tp):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer import parallel_state as ps
+        from apex_tpu.transformer import tensor_parallel as tpmod
+
+        col = tpmod.ColumnParallelLinear(32, 64, gather_output=False)
+        row = tpmod.RowParallelLinear(64, 32, input_is_parallel=True)
+
+        def loss(cp, rp, x):
+            y = row.apply(rp, jax.nn.gelu(col.apply(cp, x)))
+            return jnp.sum((y.astype(jnp.float32)) ** 2)
+
+        fn = ps.shard_map(
+            lambda cp, rp, x: jax.value_and_grad(loss, (0, 1))(cp, rp, x),
+            in_specs=(col.partition_specs(), row.partition_specs(), P()),
+            out_specs=(P(), (col.partition_specs(),
+                             row.partition_specs())))
+        cp = jax.eval_shape(lambda k: col.init(k), jax.random.PRNGKey(0))
+        rp = jax.eval_shape(lambda k: row.init(k), jax.random.PRNGKey(1))
+        return fn, (cp, rp, _sds((4, 32), "float32"))
+
+    return build
+
+
+def _bottleneck_entry():
+    def build():
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.contrib.bottleneck import spatial_parallel_bottleneck
+        from apex_tpu.transformer import parallel_state as ps
+
+        params = {"w1": _sds((1, 1, 8, 4), "float32"),
+                  "w2": _sds((3, 3, 4, 4), "float32"),
+                  "w3": _sds((1, 1, 4, 8), "float32")}
+        fn = ps.shard_map(
+            spatial_parallel_bottleneck,
+            in_specs=(P(), P(None, ps.CONTEXT_AXIS)),
+            out_specs=P(None, ps.CONTEXT_AXIS))
+        return fn, (params, _sds((2, 16, 5, 8), "float32"))
+
+    return build
+
+
+def _mesh(pp=1, vpp=None, tp=1, cp=1, n_devices=None):
+    def setup():
+        import jax
+
+        from apex_tpu.transformer import parallel_state as ps
+
+        devs = jax.devices()
+        if n_devices is not None:
+            devs = devs[:n_devices]
+        ps.initialize_model_parallel(
+            tensor_model_parallel_size_=tp,
+            pipeline_model_parallel_size_=pp,
+            virtual_pipeline_model_parallel_size_=vpp,
+            context_parallel_size_=cp,
+            devices=devs)
+
+    return setup
+
+
+def repo_entries() -> List[TraceEntry]:
+    flash = "apex_tpu.transformer.functional.flash_attention"
+    ln = "apex_tpu.normalization.fused_layer_norm"
+    flat = "apex_tpu.multi_tensor_apply.kernels"
+    sched = "apex_tpu.transformer.pipeline_parallel.schedules"
+    entries = [
+        TraceEntry("flash_d64_bf16_s512_fwd_bwd", flash,
+                   _flash_entry(64, "bfloat16", 512)),
+        TraceEntry("flash_d128_f32_s512_fwd_bwd", flash,
+                   _flash_entry(128, "float32", 512)),
+        TraceEntry("ln_h1024_fwd_bwd", ln, _ln_entry(1024)),
+        TraceEntry("rms_h4096_fwd_bwd", ln, _ln_entry(4096, rms=True)),
+        TraceEntry("xentropy_fwd_bwd", "apex_tpu.contrib.xentropy",
+                   _xentropy_entry()),
+        TraceEntry("flat_adam", flat, _flat_entry("adam"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        TraceEntry("flat_sgd", flat, _flat_entry("sgd"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=2),
+        TraceEntry("flat_lamb", flat, _flat_entry("lamb"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=2),
+        TraceEntry("flat_adagrad", flat, _flat_entry("adagrad"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=2),
+        TraceEntry("flat_novograd", flat, _flat_entry("novograd"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=2),
+        # tree path is per-leaf XLA math (no pallas kernels), so there
+        # is deliberately no aliases check here — the flat_* entries
+        # above carry the APX512 coverage
+        TraceEntry("fused_adam_tree_step",
+                   "apex_tpu.optimizers.fused_adam",
+                   _fused_adam_tree_entry()),
+        TraceEntry("amp_o2_bert_step", "apex_tpu.amp.frontend",
+                   _amp_o2_step_entry("bert"),
+                   checks=("precision", "amp", "memory")),
+        TraceEntry("amp_o2_gpt_step", "apex_tpu.amp.frontend",
+                   _amp_o2_step_entry("gpt"),
+                   checks=("precision", "amp", "memory")),
+        TraceEntry("tp_block_tp2", "apex_tpu.transformer.tensor_parallel",
+                   _tp_block_entry(2),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(tp=2), min_devices=2),
+        TraceEntry("pp_1f1b_pp4", sched, _pp_1f1b_entry(4, 8),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(pp=4, n_devices=4), min_devices=4),
+        TraceEntry("pp_interleaved_pp2_vpp2", sched,
+                   _pp_interleaved_entry(2, 2, 4),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(pp=2, vpp=2, n_devices=2), min_devices=2),
+        TraceEntry("pp_no_pipelining_fp32_accum", sched,
+                   _pp_sequential_entry()),
+        TraceEntry("bottleneck_spatial_cp2",
+                   "apex_tpu.contrib.bottleneck.bottleneck",
+                   _bottleneck_entry(),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(cp=2, n_devices=2), min_devices=2),
+    ]
+    return entries
+
+
+def check_repo() -> List[Finding]:
+    return run_entries(repo_entries())

@@ -1,0 +1,270 @@
+"""APX511 — SPMD communication-schedule simulation (static deadlock
+detector).
+
+Collectives are rendezvous points: on a real pod slice every rank must
+issue the *same collectives in the same order* along each mesh axis, or
+the mesh hangs. APX201 (the AST pass) catches rank-divergent branches
+it can see in source; this check abstract-interprets the *traced*
+``shard_map`` body once per rank instead, so divergence hidden behind
+helper functions, ``lax.cond`` lowering, or schedule arithmetic is
+caught too.
+
+Model: for every ``shard_map`` equation in the entry's jaxpr, the body
+is walked once per rank assignment (the cartesian product over mesh
+axes with size > 1). A tiny concrete interpreter propagates scalar
+integer/boolean values that derive from ``axis_index`` and literals
+through arithmetic/comparison primitives; everything else is Unknown.
+The walk emits an ordered *footprint* of nested tuples:
+
+- ``("coll", prim, axes, extra)`` for each collective —
+  ``ppermute`` includes its full permutation, ``all_to_all``/
+  ``all_gather`` their axis params;
+- ``("scan", length, body_footprint)`` / ``("while", cond_fp,
+  body_fp)`` for loops (collectives inside a loop rendezvous once per
+  iteration, so the loop structure is part of the schedule);
+- a ``cond`` with a per-rank *concrete* predicate descends the chosen
+  branch (this is where rank-divergent schedules become per-rank
+  differences); with an Unknown predicate, all branches must have
+  identical footprints, else the schedule is unverifiable and flagged.
+
+Checks: all per-rank footprints must be pairwise equal, and every
+``ppermute`` permutation must be well-formed (no duplicated source or
+destination — a duplicated endpoint is a double-send that deadlocks
+its peer).
+"""
+
+import itertools
+from typing import List, Optional, Tuple
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.traced import jaxprlib as jl
+
+_COLLECTIVES = {
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather", "axis_all_gather",
+}
+
+_MAX_RANKS = 64
+
+# Scalar primitives the concrete interpreter evaluates. Anything else
+# produces Unknown (None) values.
+_EVAL = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "rem": lambda a, b: a % b,
+    "div": lambda a, b: a // b if isinstance(a, int) and isinstance(b, int)
+    else a / b,
+    "max": lambda a, b: max(a, b),
+    "min": lambda a, b: min(a, b),
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "and": lambda a, b: bool(a) and bool(b),
+    "or": lambda a, b: bool(a) or bool(b),
+    "xor": lambda a, b: bool(a) != bool(b),
+    "not": lambda a: not a,
+    "neg": lambda a: -a,
+    "convert_element_type": lambda a: a,
+    "stop_gradient": lambda a: a,
+    "broadcast_in_dim": lambda a: a,  # scalar-to-scalar only (guarded)
+    "reshape": lambda a: a,
+    "squeeze": lambda a: a,
+}
+
+
+class _ScheduleError(Exception):
+    """An Unknown-predicate cond whose branches disagree."""
+
+
+def _is_scalar(v) -> bool:
+    return getattr(v.aval, "shape", None) == ()
+
+
+def _read(env, v):
+    lit = jl.scalar_literal(v)
+    if lit is not None:
+        return lit
+    if jl.is_literal(v):
+        return None
+    return env.get(v)
+
+
+def _footprint(jaxpr_like, env, rank) -> Tuple:
+    """Ordered collective footprint of one jaxpr for one rank."""
+    jaxpr = jl.open_jaxpr(jaxpr_like)
+    out: List[Tuple] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        vals = [_read(env, v) for v in eqn.invars]
+
+        if name == "axis_index":
+            ax = jl.axis_names(eqn.params)
+            env[eqn.outvars[0]] = rank.get(ax[0], 0) if ax else None
+            continue
+
+        if name in _COLLECTIVES:
+            axes = jl.axis_names(eqn.params)
+            extra: Tuple = ()
+            if name == "ppermute":
+                perm = tuple(tuple(p) for p in eqn.params.get("perm", ()))
+                extra = (perm,)
+            out.append(("coll", name, axes, extra))
+            continue
+
+        if name == "scan":
+            sub_env = {}
+            body = eqn.params["jaxpr"]
+            nc = eqn.params.get("num_consts", 0)
+            bj = jl.open_jaxpr(body)
+            for bv, val in zip(bj.invars[:nc], vals[:nc]):
+                sub_env[bv] = val
+            fp = _footprint(body, sub_env, rank)
+            if fp:
+                out.append(("scan", eqn.params.get("length"), fp))
+            continue
+
+        if name == "while":
+            cc = eqn.params.get("cond_nconsts", 0)
+            bc = eqn.params.get("body_nconsts", 0)
+            cfp = _footprint(eqn.params["cond_jaxpr"], {}, rank)
+            benv = {}
+            bj = jl.open_jaxpr(eqn.params["body_jaxpr"])
+            for bv, val in zip(bj.invars[:bc], vals[cc:cc + bc]):
+                benv[bv] = val
+            bfp = _footprint(eqn.params["body_jaxpr"], benv, rank)
+            if cfp or bfp:
+                out.append(("while", cfp, bfp))
+            continue
+
+        if name == "cond":
+            branches = eqn.params["branches"]
+            pred = vals[0]
+            if pred is not None:
+                idx = int(bool(pred)) if isinstance(pred, bool) else int(pred)
+                idx = max(0, min(idx, len(branches) - 1))
+                sub_env = {}
+                bj = jl.open_jaxpr(branches[idx])
+                for bv, val in zip(bj.invars, vals[1:]):
+                    sub_env[bv] = val
+                out.extend(_footprint(branches[idx], sub_env, rank))
+                continue
+            fps = []
+            for br in branches:
+                sub_env = {}
+                bj = jl.open_jaxpr(br)
+                for bv, val in zip(bj.invars, vals[1:]):
+                    sub_env[bv] = val
+                fps.append(_footprint(br, sub_env, rank))
+            if any(fp != fps[0] for fp in fps[1:]):
+                raise _ScheduleError(
+                    "a cond with an unresolvable predicate has branches "
+                    f"with different collective footprints: {fps[0]!r} "
+                    f"vs {fps[1]!r}")
+            out.extend(fps[0])
+            continue
+
+        # generic call (pjit/remat/...): inline with value propagation
+        handled = False
+        for _, sub in jl.sub_jaxprs(eqn):
+            sj = jl.open_jaxpr(sub)
+            if len(sj.invars) == len(eqn.invars):
+                sub_env = dict(zip(sj.invars, vals))
+                out.extend(_footprint(sub, sub_env, rank))
+                # propagate concrete scalar results back out
+                if len(sj.outvars) == len(eqn.outvars):
+                    for ov, sv in zip(eqn.outvars, sj.outvars):
+                        env[ov] = _read(sub_env, sv)
+                handled = True
+                break
+        if handled:
+            continue
+
+        # scalar concrete interpretation
+        fn = _EVAL.get(name)
+        if (fn is not None and all(val is not None for val in vals)
+                and all(_is_scalar(ov) for ov in eqn.outvars)
+                and all(_is_scalar(v) or jl.is_literal(v)
+                        for v in eqn.invars)):
+            try:
+                env[eqn.outvars[0]] = fn(*vals)
+            except Exception:  # noqa: BLE001 - Unknown on any failure
+                pass
+    return tuple(out)
+
+
+def _perm_findings(fp, path: str, entry: str,
+                   findings: List[Finding]) -> None:
+    for item in fp:
+        if item[0] == "coll" and item[1] == "ppermute" and item[3]:
+            perm = item[3][0]
+            srcs = [p[0] for p in perm]
+            dsts = [p[1] for p in perm]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                findings.append(Finding(
+                    "APX511", path, 1,
+                    f"entry '{entry}': ppermute permutation {perm} has a "
+                    f"duplicated source or destination — a double "
+                    f"send/recv endpoint deadlocks its peer"))
+        elif item[0] == "scan":
+            _perm_findings(item[2], path, entry, findings)
+        elif item[0] == "while":
+            _perm_findings(item[1], path, entry, findings)
+            _perm_findings(item[2], path, entry, findings)
+
+
+def _first_divergence(a, b, prefix="") -> str:
+    for i, (x, y) in enumerate(itertools.zip_longest(a, b)):
+        if x != y:
+            return (f"{prefix}step {i}: {x!r} vs {y!r}")
+    return f"{prefix}lengths {len(a)} vs {len(b)}"
+
+
+def check(closed, path: str, entry: str,
+          max_ranks: int = _MAX_RANKS) -> List[Finding]:
+    findings: List[Finding] = []
+    for eqn in jl.all_eqns(closed, into_pallas=False):
+        if eqn.primitive.name != "shard_map":
+            continue
+        mesh = eqn.params.get("mesh")
+        try:
+            axis_sizes = dict(mesh.shape)
+        except Exception:  # noqa: BLE001
+            axis_sizes = {}
+        active = [(ax, n) for ax, n in axis_sizes.items() if n > 1]
+        n_ranks = 1
+        for _, n in active:
+            n_ranks *= n
+        if n_ranks > max_ranks:
+            active = active[:1]  # degrade to one axis rather than skip
+
+        rank_fps = []
+        body = eqn.params["jaxpr"]
+        for combo in itertools.product(*[range(n) for _, n in active]):
+            rank = {ax: idx for (ax, _), idx in zip(active, combo)}
+            try:
+                fp = _footprint(body, {}, rank)
+            except _ScheduleError as e:
+                findings.append(Finding(
+                    "APX511", path, 1, f"entry '{entry}': {e}"))
+                rank_fps = []
+                break
+            rank_fps.append((rank, fp))
+        if not rank_fps:
+            continue
+
+        _perm_findings(rank_fps[0][1], path, entry, findings)
+        rank0, fp0 = rank_fps[0]
+        for rank, fp in rank_fps[1:]:
+            if fp != fp0:
+                findings.append(Finding(
+                    "APX511", path, 1,
+                    f"entry '{entry}': collective schedule diverges "
+                    f"between rank {rank0} and rank {rank} — "
+                    f"{_first_divergence(fp0, fp)} (multi-chip "
+                    f"deadlock)"))
+                break
+    return findings

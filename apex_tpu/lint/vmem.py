@@ -288,6 +288,46 @@ def _flat_cfg(which):
     return build
 
 
+def _bottleneck_cfg():
+    """Halo'd 3x3-conv spatial bottleneck, H sharded over ``context``.
+
+    The block's compute is XLA convs today, so the capture records no
+    pallas calls — registering it pins the *trace* (a halo-exchange or
+    conv regression surfaces as APX100) and budget-checks any Pallas
+    kernel that later lands in the halo path. Uses an explicit local
+    2-device mesh so the global parallel state is untouched; on a
+    single-device rig it degrades to the unsharded reference block
+    (same convs, no exchange).
+    """
+    def build():
+        import jax
+
+        from apex_tpu.contrib.bottleneck import (
+            spatial_bottleneck, spatial_parallel_bottleneck,
+        )
+
+        params = {"w1": _sds((1, 1, 8, 4), "float32"),
+                  "w2": _sds((3, 3, 4, 4), "float32"),
+                  "w3": _sds((1, 1, 4, 8), "float32")}
+        x = _sds((2, 16, 5, 8), "float32")
+        if len(jax.devices()) < 2:
+            return spatial_bottleneck, (params, x)
+
+        import numpy as np
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer import parallel_state as ps
+
+        mesh = Mesh(np.array(jax.devices()[:2]), (ps.CONTEXT_AXIS,))
+        fn = ps.shard_map(spatial_parallel_bottleneck, mesh=mesh,
+                          in_specs=(P(), P(None, ps.CONTEXT_AXIS)),
+                          out_specs=P(None, ps.CONTEXT_AXIS))
+        return fn, (params, x)
+
+    return build
+
+
 def repo_configs() -> List[Config]:
     flat = "apex_tpu.multi_tensor_apply.kernels"
     flash = "apex_tpu.transformer.functional.flash_attention"
@@ -304,6 +344,9 @@ def repo_configs() -> List[Config]:
     for which in ("adam", "sgd", "lamb", "adagrad", "novograd", "scale",
                   "axpby", "l2norm"):
         cfgs.append(Config(f"flat_{which}", flat, _flat_cfg(which)))
+    cfgs.append(Config("bottleneck_spatial_cp2",
+                       "apex_tpu.contrib.bottleneck.bottleneck",
+                       _bottleneck_cfg()))
     return cfgs
 
 

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.autocast import cast_args
 from apex_tpu.models import layers as L
 from apex_tpu.normalization import fused_layer_norm_affine
 
@@ -129,7 +130,7 @@ def _attention(p, cfg: BertConfig, x, mask, dropout_rng=None):
             dropout_rate=cfg.attention_dropout, dropout_rng=dropout_rng)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
         return L.dense(p["out"], ctx)
-    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k)
+    scores = jnp.einsum("bnqd,bnkd->bnqk", *cast_args("einsum", q, k))
     if mask is not None:
         # mask: (b, s) with 1 = attend; the fused kernel masks nonzero
         inv = (1 - mask)[:, None, None, :]
@@ -140,7 +141,7 @@ def _attention(p, cfg: BertConfig, x, mask, dropout_rng=None):
         keep = jax.random.bernoulli(dropout_rng, 1 - cfg.attention_dropout,
                                     probs.shape)
         probs = probs * keep / (1 - cfg.attention_dropout)
-    ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+    ctx = jnp.einsum("bnqk,bnkd->bnqd", *cast_args("einsum", probs, v))
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
     return L.dense(p["out"], ctx)
 

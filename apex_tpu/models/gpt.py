@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.amp.autocast import cast_args
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.utils.compat import axis_size
@@ -569,7 +570,9 @@ def gpt_loss_unsharded(params: Dict[str, Any], cfg: GPTConfig,
                                  dropout_rng=dropout_rng,
                                  compute_dtype=compute_dtype)
     table = params["embedding"]["word"]["embedding"]
-    logits = jnp.dot(hidden, table.astype(hidden.dtype).T)
+    hidden, table_t = cast_args("matmul", hidden,
+                                table.astype(hidden.dtype).T)
+    logits = jnp.dot(hidden, table_t)
     # fused xentropy (ref apex/contrib/xentropy): fp32 logsumexp inside
     # the kernel, no (b, s, V) log-softmax ever materialized — at
     # V=50304 that tensor dominated the unsharded step's HBM footprint

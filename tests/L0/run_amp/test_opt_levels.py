@@ -145,3 +145,72 @@ def test_model_params_from_master_precast():
     assert got["batch_norm"]["scale"].dtype == jnp.float32
     np.testing.assert_array_equal(np.asarray(got["batch_norm"]["scale"]),
                                   np.asarray(master["batch_norm"]["scale"]))
+
+
+def _dots_by_dtype(closed, dtype):
+    """dot_general eqns (outside pallas bodies) with all operands in dtype."""
+    from apex_tpu.lint.traced import jaxprlib as jl
+
+    return [e for e in jl.all_eqns(closed, into_pallas=False)
+            if e.primitive.name == "dot_general"
+            and all(v.aval.dtype == dtype for v in e.invars)]
+
+
+def test_o1_einsum_policy():
+    h = amp.initialize("O1", verbosity=0)
+    x = jnp.ones((2, 4), jnp.float32)
+    with h.autocast():
+        a, b = amp.cast_args("einsum", x, x)
+        assert a.dtype == b.dtype == jnp.bfloat16
+    a, b = amp.cast_args("einsum", x, x)
+    assert a.dtype == jnp.float32  # passthrough outside the context
+
+
+def test_o1_bert_unfused_attention_traces_bf16():
+    """The unfused-attention einsums ride the O1 policy: every matmul in
+    the traced forward runs bf16 under autocast and fp32 without."""
+    import dataclasses
+
+    from apex_tpu.models import bert
+
+    cfg = dataclasses.replace(bert.bert_tiny(), fused_attention=False)
+    params = bert.init_bert(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+
+    # distinct lambdas: jax caches traces on function identity, and the
+    # autocast context is trace-time state invisible to that cache
+    h = amp.initialize("O1", verbosity=0)
+    with h.autocast():
+        hot = jax.make_jaxpr(
+            lambda p: bert.apply_bert(p, cfg, ids)["mlm_logits"])(params)
+    cold = jax.make_jaxpr(
+        lambda p: bert.apply_bert(p, cfg, ids)["mlm_logits"])(params)
+
+    # 2 attention einsums per layer, on top of the dense sites
+    assert len(_dots_by_dtype(hot, jnp.bfloat16)) >= 2 * cfg.num_layers
+    assert not _dots_by_dtype(cold, jnp.bfloat16)
+
+
+def test_o1_gpt_logits_matmul_traces_bf16():
+    from apex_tpu.models import gpt
+
+    cfg = gpt.gpt_tiny()
+    params = gpt.init_gpt(jax.random.PRNGKey(0), cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+
+    h = amp.initialize("O1", verbosity=0)
+    with h.autocast():
+        hot = jax.make_jaxpr(
+            lambda p: gpt.gpt_loss_unsharded(p, cfg, ids, ids))(params)
+    cold = jax.make_jaxpr(
+        lambda p: gpt.gpt_loss_unsharded(p, cfg, ids, ids))(params)
+
+    def logits_dots(closed, dtype):
+        # the tied-embedding head: rhs is the transposed (h, vocab) table
+        return [e for e in _dots_by_dtype(closed, dtype)
+                if e.invars[1].aval.shape[-2:] == (cfg.hidden_size,
+                                                   cfg.vocab_size)]
+
+    assert logits_dots(hot, jnp.bfloat16)
+    assert not logits_dots(cold, jnp.bfloat16)
+    assert logits_dots(cold, jnp.float32)

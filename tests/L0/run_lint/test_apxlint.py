@@ -26,6 +26,8 @@ def test_codes_registry_complete():
         "APX201", "APX202",
         "APX301", "APX302", "APX303", "APX304",
         "APX401", "APX402",
+        "APX501", "APX502", "APX503",
+        "APX511", "APX512",
     }
     assert all(CODES[c] for c in CODES)  # every code documented
 
@@ -65,6 +67,12 @@ def test_apx402_global_write():
 
 def test_suppression_comments():
     assert _codes("suppressed.py") == []
+
+
+def test_file_level_suppression():
+    # same violations as apx401_bad/apx402_bad, silenced by one
+    # `# apxlint: disable-file=...` header comment
+    assert _codes("suppressed_file.py") == []
 
 
 def test_amp_list_coherence():

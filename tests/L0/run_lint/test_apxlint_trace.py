@@ -1,0 +1,431 @@
+"""Trace-tier (APX5xx) tests.
+
+Three layers, per the tier's contract:
+
+- known-bad / known-clean *entry* pairs: every verifier must fire on a
+  builder that seeds exactly its invariant violation and stay silent on
+  the minimally-different clean twin;
+- seeded-bug meta-tests: a scratch copy of a real repo module gets one
+  invariant textually broken (``fp32_grad_accum`` default flipped, the
+  adam ``input_output_aliases`` dict emptied), is imported under a
+  throwaway name, traced, and the verifier must fire — while the
+  unmodified module stays silent under the identical harness;
+- the repo registry itself must be populated (>= 15 entries) and clean.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu.lint.traced.registry import (  # noqa: E402
+    TraceEntry, _sds, run_entries,
+)
+
+MOD = "apex_tpu.lint"  # attribution target for synthetic entries
+
+
+def _codes(entries):
+    return [f.code for f in run_entries(entries)]
+
+
+def _msgs(entries):
+    return [f.message for f in run_entries(entries)]
+
+
+# ---------------------------------------------------------------------------
+# APX501 — sub-fp32 accumulators
+# ---------------------------------------------------------------------------
+
+def _b501_bad():
+    fn = lambda x: jnp.cumsum(x, axis=-1)  # bf16 prefix accumulator
+    return fn, (_sds((4, 2048), "bfloat16"),)
+
+
+def _b501_clean():
+    # jnp.sum upcasts bf16 to an fp32 accumulator on its own — the
+    # clean twin of the same reduction
+    fn = lambda x: jnp.sum(x, axis=-1)
+    return fn, (_sds((4, 2048), "bfloat16"),)
+
+
+def test_apx501_bad_and_clean():
+    assert _codes([TraceEntry("bad", MOD, _b501_bad)]) == ["APX501"]
+    assert _codes([TraceEntry("clean", MOD, _b501_clean)]) == []
+
+
+def test_apx501_short_reductions_exempt():
+    # a 64-long bf16 bias-wgrad-style fold is below the accumulation-
+    # length threshold and must not fire
+    def build():
+        fn = lambda x: jnp.sum(x, axis=0, dtype=jnp.bfloat16)
+        return fn, (_sds((64, 128), "bfloat16"),)
+
+    assert _codes([TraceEntry("short", MOD, build)]) == []
+
+
+def test_apx501_residual_carry_not_flagged():
+    # x_{i+1} = x_i + f(x_i) is a residual, not an accumulator
+    def build():
+        def f(x, ws):
+            def body(c, w):
+                return c + jnp.tanh(c * w), None
+            return jax.lax.scan(body, x, ws)[0]
+        return f, (_sds((8, 16), "bfloat16"), _sds((4,), "bfloat16"))
+
+    assert _codes([TraceEntry("residual", MOD, build)]) == []
+
+
+def test_apx501_bf16_scan_accumulator_flagged():
+    # acc_{i+1} = acc_i + g(xs_i) in bf16 is the bug
+    def build():
+        def f(xs):
+            def body(acc, x):
+                return acc + x * 2.0, None
+            return jax.lax.scan(body, jnp.zeros((16,), jnp.bfloat16),
+                                xs)[0]
+        return f, (_sds((8, 16), "bfloat16"),)
+
+    assert _codes([TraceEntry("accum", MOD, build)]) == ["APX501"]
+
+
+# ---------------------------------------------------------------------------
+# APX502 — unscale / overflow-guard placement
+# ---------------------------------------------------------------------------
+
+def _amp_entry(build):
+    return TraceEntry("amp", "apex_tpu.amp.frontend", build,
+                      checks=("amp",))
+
+
+def _b502_noguard():
+    def step(scale, p, x):
+        g = jax.grad(lambda q: jnp.sum((q * x) ** 2) * scale)(p)
+        g = g / scale
+        return (p - 0.1 * g,), None  # no finite-flag select
+
+    return step, (_sds((), "float32"), _sds((8,), "float32"),
+                  _sds((8,), "float32"))
+
+
+def _b502_nounscale():
+    def step(scale, p, x):
+        g = jax.grad(lambda q: jnp.sum((q * x) ** 2) * scale)(p)
+        fin = jnp.isfinite(g).all()
+        return (jnp.where(fin, p - 0.1 * g, p),), None  # scaled grads
+
+    return step, (_sds((), "float32"), _sds((8,), "float32"),
+                  _sds((8,), "float32"))
+
+
+def _b502_clean():
+    def step(scale, p, x):
+        g = jax.grad(lambda q: jnp.sum((q * x) ** 2) * scale)(p)
+        g = g / scale
+        fin = jnp.isfinite(g).all()
+        return (jnp.where(fin, p - 0.1 * g, p),), None
+
+    return step, (_sds((), "float32"), _sds((8,), "float32"),
+                  _sds((8,), "float32"))
+
+
+def test_apx502_bad_and_clean():
+    msgs = _msgs([_amp_entry(_b502_noguard)])
+    assert len(msgs) == 1 and "overflow check" in msgs[0]
+    msgs = _msgs([_amp_entry(_b502_nounscale)])
+    assert len(msgs) == 1 and "missing unscale" in msgs[0]
+    assert _codes([_amp_entry(_b502_clean)]) == []
+
+
+# ---------------------------------------------------------------------------
+# APX503 — materialization blowup
+# ---------------------------------------------------------------------------
+
+def _b503_bad():
+    def f(q, k):
+        s = jnp.einsum("sd,td->st", q.astype(jnp.float32),
+                       k.astype(jnp.float32))  # (2048, 2048) fp32
+        return jax.nn.softmax(s, axis=-1).sum()
+
+    return f, (_sds((2048, 32), "bfloat16"), _sds((2048, 32), "bfloat16"))
+
+
+def _b503_clean():
+    # the chunked twin: 64-row score tiles stay under the floor
+    def f(q, k):
+        kf = k.astype(jnp.float32)
+
+        def chunk(acc, qc):
+            s = qc.astype(jnp.float32) @ kf.T  # (64, 2048) = 512 KiB
+            return acc + jax.nn.softmax(s, axis=-1).sum(), None
+
+        qs = q.reshape(32, 64, 32)
+        return jax.lax.scan(chunk, jnp.float32(0.0), qs)[0]
+
+    return f, (_sds((2048, 32), "bfloat16"), _sds((2048, 32), "bfloat16"))
+
+
+def test_apx503_bad_and_clean():
+    bad = TraceEntry("bad", MOD, _b503_bad, checks=("memory",))
+    clean = TraceEntry("clean", MOD, _b503_clean, checks=("memory",))
+    assert _codes([bad]) == ["APX503"]
+    assert _codes([clean]) == []
+
+
+# ---------------------------------------------------------------------------
+# APX511 — communication-schedule simulation
+# ---------------------------------------------------------------------------
+
+def _mesh_cp2():
+    from apex_tpu.transformer import parallel_state as ps
+
+    ps.initialize_model_parallel(context_parallel_size_=2,
+                                 devices=jax.devices()[:2])
+
+
+def _sched_entry(name, build):
+    return TraceEntry(name, "apex_tpu.transformer.parallel_state", build,
+                      checks=("schedule",), mesh=_mesh_cp2, min_devices=2)
+
+
+def _b511_divergent():
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    def body(x):
+        i = jax.lax.axis_index(ps.CONTEXT_AXIS)
+        return jax.lax.cond(
+            i == 0,
+            lambda v: jax.lax.psum(v, ps.CONTEXT_AXIS),
+            lambda v: v * 2.0, x)
+
+    fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                      out_specs=P(ps.CONTEXT_AXIS))
+    return fn, (_sds((8, 4), "float32"),)
+
+
+def _b511_clean():
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    def body(x):
+        # rank-dependent *math* with a rank-independent schedule
+        i = jax.lax.axis_index(ps.CONTEXT_AXIS)
+        y = jnp.where(i == 0, x * 2.0, x)
+        return jax.lax.psum(y, ps.CONTEXT_AXIS)
+
+    fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                      out_specs=P())
+    return fn, (_sds((8, 4), "float32"),)
+
+
+def _b511_bad_perm():
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    def body(x):
+        # duplicated destination: both ranks send into rank 1
+        return jax.lax.ppermute(x, ps.CONTEXT_AXIS,
+                                perm=((0, 1), (1, 1)))
+
+    fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                      out_specs=P(ps.CONTEXT_AXIS))
+    return fn, (_sds((8, 4), "float32"),)
+
+
+def _skip_if_few_devices(n=2):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def test_apx511_divergent_schedule():
+    _skip_if_few_devices()
+    msgs = _msgs([_sched_entry("bad", _b511_divergent)])
+    assert len(msgs) == 1 and "diverges" in msgs[0], msgs
+
+
+def test_apx511_clean_schedule():
+    _skip_if_few_devices()
+    assert _codes([_sched_entry("clean", _b511_clean)]) == []
+
+
+def test_apx511_malformed_ppermute():
+    _skip_if_few_devices()
+    findings = run_entries([_sched_entry("perm", _b511_bad_perm)])
+    assert any(f.code == "APX511" and "duplicated" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# APX512 — verified aliasing
+# ---------------------------------------------------------------------------
+
+def _alias_entry(name, build, min_pairs):
+    return TraceEntry(name, "apex_tpu.multi_tensor_apply.kernels", build,
+                      checks=("aliases",), min_alias_pairs=min_pairs)
+
+
+def _b512_severed():
+    from apex_tpu.multi_tensor_apply import kernels as K
+
+    def f(g, p, m, v):
+        return K.flat_adam(g, p * 1.0, m, v, lr=1e-3, beta1=0.9,
+                           beta2=0.99, eps=1e-8, step=1,
+                           weight_decay=0.0, interpret=True)
+
+    buf = _sds((8192, 128), "float32")
+    return f, (buf, buf, buf, buf)
+
+
+def _b512_clean():
+    from apex_tpu.multi_tensor_apply import kernels as K
+
+    def f(g, p, m, v):
+        return K.flat_adam(g, p, m, v, lr=1e-3, beta1=0.9, beta2=0.99,
+                           eps=1e-8, step=1, weight_decay=0.0,
+                           interpret=True)
+
+    buf = _sds((8192, 128), "float32")
+    return f, (buf, buf, buf, buf)
+
+
+def _b512_no_pairs():
+    fn = lambda x: x * 2.0  # no pallas_call at all
+    return fn, (_sds((8,), "float32"),)
+
+
+def test_apx512_severed_and_clean():
+    msgs = _msgs([_alias_entry("bad", _b512_severed, 3)])
+    assert any("produced by 'mul'" in m for m in msgs), msgs
+    assert _codes([_alias_entry("clean", _b512_clean, 3)]) == []
+
+
+def test_apx512_dropped_pairs():
+    msgs = _msgs([_alias_entry("none", _b512_no_pairs, 1)])
+    assert len(msgs) == 1 and "dropped" in msgs[0]
+
+
+# ---------------------------------------------------------------------------
+# seeded-bug meta-tests over scratch copies of real modules
+# ---------------------------------------------------------------------------
+
+def _scratch_import(src_path, transform, tmp_path, name):
+    txt = open(src_path, encoding="utf-8").read()
+    seeded = transform(txt)
+    assert seeded != txt, "seed transform did not apply"
+    p = os.path.join(str(tmp_path), name + ".py")
+    with open(p, "w", encoding="utf-8") as fh:
+        fh.write(seeded)
+    spec = importlib.util.spec_from_file_location(name, p)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        sys.modules.pop(name, None)
+        raise
+    return mod
+
+
+def _bf16_params(tree):
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16)
+        if jnp.issubdtype(s.dtype, jnp.floating) else s, tree)
+
+
+def test_seeded_fp32_grad_accum_flip_fires_apx501(tmp_path):
+    from apex_tpu.lint.traced import precision
+    from apex_tpu.lint.traced.registry import _pp_args, _pp_model
+    from apex_tpu.transformer.pipeline_parallel import schedules
+
+    seeded = _scratch_import(
+        schedules.__file__,
+        lambda t: t.replace("fp32_grad_accum: bool = True",
+                            "fp32_grad_accum: bool = False"),
+        tmp_path, "schedules_seeded_apx501")
+
+    model = _pp_model()
+    params, mb = _pp_args(3, 4)
+    params = _bf16_params(params)
+
+    def trace(mod):
+        fn = lambda p, b: mod.forward_backward_no_pipelining(
+            model, p, b, num_microbatches=2)
+        return jax.make_jaxpr(fn)(params, mb)
+
+    bad = precision.check_reductions(trace(seeded), "x.py", "seeded")
+    assert bad and all(f.code == "APX501" for f in bad)
+    assert "fp32_grad_accum" in bad[0].message
+    # identical harness, unmodified module: silent
+    assert precision.check_reductions(trace(schedules), "x.py",
+                                      "clean") == []
+
+
+def test_seeded_alias_drop_fires_apx512(tmp_path):
+    from apex_tpu.lint.traced import aliases
+    from apex_tpu.multi_tensor_apply import kernels
+
+    seeded = _scratch_import(
+        kernels.__file__,
+        lambda t: t.replace("input_output_aliases={2: 0, 3: 1, 4: 2},",
+                            "input_output_aliases={},"),
+        tmp_path, "kernels_seeded_apx512")
+
+    buf = _sds((8192, 128), "float32")
+
+    def trace(mod):
+        fn = lambda g, p, m, v: mod.flat_adam(
+            g, p, m, v, lr=1e-3, beta1=0.9, beta2=0.99, eps=1e-8,
+            step=1, weight_decay=0.0, interpret=True)
+        return jax.make_jaxpr(fn)(buf, buf, buf, buf)
+
+    bad = aliases.check(trace(seeded), "x.py", "seeded",
+                        min_alias_pairs=3)
+    assert [f.code for f in bad] == ["APX512"]
+    assert "dropped" in bad[0].message
+    assert aliases.check(trace(kernels), "x.py", "clean",
+                         min_alias_pairs=3) == []
+
+
+# ---------------------------------------------------------------------------
+# registry + engine integration
+# ---------------------------------------------------------------------------
+
+def test_trace_registry_populated_and_clean():
+    from apex_tpu.lint import traced
+
+    entries = traced.repo_entries()
+    assert len(entries) >= 15, len(entries)
+    findings = traced.check_repo()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_trace_failure_is_apx100_not_silent():
+    def broken():
+        raise RuntimeError("boom")
+
+    findings = run_entries([TraceEntry("broken", MOD, broken)])
+    assert [f.code for f in findings] == ["APX100"]
+    assert "broken" in findings[0].message
+
+
+def test_trace_findings_pass_suppression_machinery(tmp_path):
+    # engine attribution: a trace finding lands on the module file and
+    # a file-level disable-file comment suppresses it
+    from apex_tpu.lint import Finding
+    from apex_tpu.lint.engine import _apply_suppressions
+
+    mod = tmp_path / "fake_mod.py"
+    mod.write_text("# apxlint: disable-file=APX501\nx = 1\n")
+    kept = _apply_suppressions(
+        [Finding("APX501", str(mod), 1, "seeded"),
+         Finding("APX503", str(mod), 1, "kept")],
+        {})
+    assert [f.code for f in kept] == ["APX503"]
