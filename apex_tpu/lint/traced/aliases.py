@@ -21,6 +21,15 @@ For every ``pallas_call`` equation in the entry's jaxpr, each declared
   at an *invar* of the jaxpr the call sits in — i.e. the caller's
   buffer, not a fresh intermediate.
 
+The same contract covers jit DONATIONS (``donate_argnums``): a traced
+``pjit`` equation carries ``donated_invars``, and the serving KV cache
+depends on its donation surviving — a dropped donation turns every
+decode step's cache update into a fresh ``O(L·B·H·S·d)`` allocation.
+Each donated invar must have a shape/dtype-matching output to land in
+(XLA only reuses buffers between compatible avals; a donation with no
+matching output is silently discarded and the HBM win evaporates).
+Donated invars count toward ``min_alias_pairs`` alongside pallas pairs.
+
 Each entry declares ``min_alias_pairs``: if fewer pairs survive into
 the trace than the kernel registry promises (e.g. a refactor dropped
 the parameter), that is a finding too.
@@ -71,6 +80,8 @@ def _check_jaxpr(jaxpr_like, path, entry, counts, findings):
     invars = set(jaxpr.invars)
     for eqn in jaxpr.eqns:
         if eqn.primitive.name != "pallas_call":
+            if eqn.primitive.name == "pjit":
+                _check_donations(eqn, path, entry, counts, findings)
             for _, sub in jl.sub_jaxprs(eqn):
                 _check_jaxpr(sub, path, entry, counts, findings)
             continue
@@ -105,6 +116,37 @@ def _check_jaxpr(jaxpr_like, path, entry, counts, findings):
                     f"'{_kernel_of(eqn)}' is produced by '{sever}', not "
                     f"the caller's buffer — the declared in-place "
                     f"update writes to a copy and HBM traffic doubles"))
+
+
+def _check_donations(eqn, path, entry, counts, findings):
+    """``pjit`` donations (``donate_argnums``): each donated invar needs
+    a shape/dtype-matching output for XLA to land the reuse in — each
+    output can absorb at most one donation."""
+    donated = eqn.params.get("donated_invars") or ()
+    if not any(donated):
+        return
+    taken = [False] * len(eqn.outvars)
+    for in_idx, is_donated in enumerate(donated):
+        if not is_donated:
+            continue
+        op_aval = eqn.invars[in_idx].aval
+        for out_idx, out in enumerate(eqn.outvars):
+            if taken[out_idx]:
+                continue
+            if (getattr(out.aval, "shape", None) == getattr(
+                    op_aval, "shape", None)
+                    and getattr(out.aval, "dtype", None) == getattr(
+                        op_aval, "dtype", None)):
+                taken[out_idx] = True
+                counts[0] += 1
+                break
+        else:
+            findings.append(Finding(
+                "APX512", path, 1,
+                f"entry '{entry}': donated operand {in_idx} of "
+                f"'{_kernel_of(eqn)}' ({op_aval}) has no shape/dtype-"
+                f"matching output to reuse — XLA discards the donation "
+                f"and the update allocates a fresh buffer"))
 
 
 def _kernel_of(eqn) -> str:

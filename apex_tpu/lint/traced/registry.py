@@ -514,6 +514,58 @@ def _bottleneck_entry():
     return build
 
 
+def _serving_cfg():
+    import dataclasses
+
+    from apex_tpu.models.gpt import gpt_tiny
+
+    return dataclasses.replace(gpt_tiny(), use_rope=True)
+
+
+def _serving_args(cfg, num_slots=2, max_len=32):
+    import functools as ft
+
+    import jax
+
+    from apex_tpu.models.gpt import init_gpt
+    from apex_tpu.serving.cache import init_cache
+
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(ft.partial(init_cache, cfg, num_slots, max_len))
+    return params, cache
+
+
+def _prefill_step_entry():
+    def build():
+        from apex_tpu.serving.decode import make_prefill_fn
+
+        cfg = _serving_cfg()
+        params, cache = _serving_args(cfg)
+        fn = make_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 16), "int32"),
+                    _sds((16,), "int32"), _sds((), "int32"))
+
+    return build
+
+
+def _decode_step_entry(tp=None):
+    def build():
+        from apex_tpu.serving.decode import make_decode_fn, make_tp_decode_fn
+
+        cfg = _serving_cfg()
+        params, cache = _serving_args(cfg)
+        if tp is None:
+            fn = make_decode_fn(cfg)
+        else:
+            from apex_tpu.models.gpt import GPTModel
+
+            fn = make_tp_decode_fn(GPTModel(cfg, tp_size=tp))
+        return fn, (params, cache, _sds((2,), "int32"), _sds((2,), "bool"))
+
+    return build
+
+
 def _mesh(pp=1, vpp=None, tp=1, cp=1, n_devices=None):
     def setup():
         import jax
@@ -592,6 +644,22 @@ def repo_entries() -> List[TraceEntry]:
                    _bottleneck_entry(),
                    checks=("precision", "memory", "schedule"),
                    mesh=_mesh(cp=2, n_devices=2), min_devices=2),
+        # serving: the KV cache (k, v, lengths) is DONATED into both
+        # jitted steps — min_alias_pairs=3 pins the donation (APX512's
+        # pjit branch); a dropped donate_argnums re-allocates the whole
+        # cache every decoded token
+        TraceEntry("gpt_prefill_step", "apex_tpu.serving.decode",
+                   _prefill_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        TraceEntry("gpt_decode_step", "apex_tpu.serving.decode",
+                   _decode_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        TraceEntry("gpt_decode_step_tp2", "apex_tpu.serving.decode",
+                   _decode_step_entry(tp=2),
+                   checks=("precision", "memory", "schedule", "aliases"),
+                   mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=3),
     ]
     return entries
 

@@ -283,6 +283,96 @@ def _block(lp, x, cfg, rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn,
     return x + mlp
 
 
+# ---------------------------------------------------------------------------
+# cache-aware block apply (serving): prefill and single-token decode.
+# Parameterized by the same linear fns as _block so the unsharded golden
+# path and the TP path share one body (apex_tpu.serving builds both).
+# ---------------------------------------------------------------------------
+
+def _prefill_attention(q_k_v: jax.Array, cfg: GPTConfig,
+                       rope_freqs: Optional[jax.Array],
+                       key_mask: Optional[jax.Array]):
+    """Like :func:`_causal_attention` but also returns the (post-RoPE)
+    k and raw v tiles so the caller can populate a KV cache, and takes
+    an explicit ``key_mask`` ((b, s) int, 1 = real token) so a
+    bucket-padded prompt's pad tail is excluded as KEYS. Causality
+    already protects real queries from the tail pads (pads sit at the
+    END of the bucket), but the mask makes the exclusion unconditional
+    — prefill numerics can never depend on pad contents."""
+    b, s, _ = q_k_v.shape
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs)
+    ctx = flash_attention(q, k, v, key_mask, causal=True,
+                          softmax_scale=1.0 / math.sqrt(hd))
+    return ctx.transpose(0, 2, 1, 3).reshape(b, s, -1), k, v
+
+
+def _decode_attention(q_k_v: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, pos: jax.Array,
+                      cfg: GPTConfig, rope_freqs: Optional[jax.Array]):
+    """Single-query attention against a per-slot KV cache.
+
+    ``q_k_v`` is (b, 1, 3*h_local) — the new token's fused projection;
+    ``k_cache``/``v_cache`` are (b, nh_local, S_max, hd); ``pos`` (b,)
+    int32 is each slot's current length (= the new token's absolute
+    position). The new k/v row is written (``lax.dynamic_update_slice``)
+    BEFORE attending, so the ``s <= pos`` score mask only ever admits
+    rows that hold real tokens — cached pad/stale rows beyond ``pos``
+    are unreachable by construction. Scores/softmax run in fp32 (the
+    cache may be bf16); returns (ctx (b, 1, h_local), k_cache, v_cache).
+    """
+    b = q_k_v.shape[0]
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, 1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+
+    def write(cache, new, p):
+        return lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    k_cache = jax.vmap(write)(k_cache, k.astype(k_cache.dtype), pos)
+    v_cache = jax.vmap(write)(v_cache, v.astype(v_cache.dtype), pos)
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / math.sqrt(hd)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= pos[:, None, None, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     v_cache.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, 1, -1), k_cache, v_cache
+
+
+def _block_prefill(lp, x, cfg, rope_freqs, key_mask,
+                   qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block` that also emits this layer's (k, v) cache tiles."""
+    att, k, v = _prefill_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        cfg, rope_freqs, key_mask)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k, v
+
+
+def _block_decode(lp, x, k_cache, v_cache, pos, cfg, rope_freqs,
+                  qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block` against the cache: x is the (b, 1, h) new-token
+    hidden; returns (x', k_cache', v_cache')."""
+    att, k_cache, v_cache = _decode_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_cache, v_cache, pos, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_cache, v_cache
+
+
 def _maybe_dropout(x, rate, rng, salt):
     if rng is None or rate <= 0:
         return x

@@ -1,0 +1,26 @@
+"""Serving: KV-cached incremental decode for the in-tree GPT.
+
+Reference anchor: the apex-fed Megatron stacks are served with
+KV-cached autoregressive generation (``megatron/text_generation``);
+this package is that path for ``apex_tpu.models.gpt``, TPU-first:
+
+- ``cache``     — preallocated per-layer K/V buffers + per-slot length
+  tracking, updated in place via ``lax.dynamic_update_slice`` with
+  buffer donation (apxlint APX512 pins the donation in the trace tier);
+- ``decode``    — bucketed prefill + single-token decode steps, an
+  unsharded path and a TP-sharded path (heads over the ``model`` axis);
+- ``sampling``  — greedy / temperature / top-k under explicit PRNG keys;
+- ``scheduler`` — fixed-slot continuous batching (admit/evict on EOS or
+  max-len; jit recompiles only per prompt bucket, never per request).
+"""
+
+from apex_tpu.serving.cache import (  # noqa: F401
+    KVCache, cache_partition_specs, init_cache,
+)
+from apex_tpu.serving.decode import (  # noqa: F401
+    make_decode_fn, make_prefill_fn, make_tp_decode_fn, make_tp_prefill_fn,
+)
+from apex_tpu.serving.sampling import sample_tokens  # noqa: F401
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler, DecodeEngine, Request,
+)

@@ -1,0 +1,68 @@
+"""KV cache: preallocated per-layer key/value buffers + slot lengths.
+
+Layout: ``k``/``v`` are ``(num_layers, num_slots, num_heads, S_max,
+head_dim)`` — the per-layer ``[B, H, S, d]`` buffers of the design doc,
+stacked on a leading layer axis to match the model's stacked-layer
+``lax.scan`` (the depth loop slices one layer's cache per iteration with
+no re-plumbing). ``lengths`` is ``(num_slots,)`` int32 — how many
+positions of each slot hold real tokens; it is simultaneously the next
+write offset and the attention-mask bound (decode masks scores to
+``s <= pos`` AFTER writing the new row, so stale rows past the length
+are unreachable).
+
+The cache is updated with ``lax.dynamic_update_slice`` inside a jit
+whose cache argument is DONATED: XLA reuses the input buffer for the
+output and a decode step is one in-place write per layer, not a fresh
+``O(L·B·H·S·d)`` allocation. The trace-tier linter (APX512) pins the
+donation — see ``apex_tpu/lint/traced/aliases.py`` and the
+``gpt_decode_step`` registry entries.
+
+dtype: bf16 halves cache HBM and decode is score-bound, not
+precision-bound (scores/softmax stay fp32 in ``_decode_attention``);
+fp32 is for parity tests. Under TP the head axis (2) shards over the
+``model`` mesh axis — each rank holds its local heads' cache, matching
+the head-major qkv column shard.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTConfig
+
+
+class KVCache(NamedTuple):
+    k: jax.Array        # (L, num_slots, num_heads, S_max, head_dim)
+    v: jax.Array        # (L, num_slots, num_heads, S_max, head_dim)
+    lengths: jax.Array  # (num_slots,) int32, valid positions per slot
+
+
+def init_cache(cfg: GPTConfig, num_slots: int, max_len: int,
+               dtype=jnp.bfloat16) -> KVCache:
+    """Zero-filled cache for ``num_slots`` concurrent sequences of up to
+    ``max_len`` tokens each (prompt + generated)."""
+    if max_len < 1 or num_slots < 1:
+        raise ValueError(
+            f"need positive num_slots/max_len, got {num_slots}/{max_len}")
+    if not cfg.use_rope and max_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_len {max_len} exceeds the learned position table "
+            f"({cfg.max_position_embeddings}); raise "
+            "max_position_embeddings or use rope")
+    shape = (cfg.num_layers, num_slots, cfg.num_heads, max_len,
+             cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((num_slots,), jnp.int32))
+
+
+def cache_partition_specs() -> KVCache:
+    """TP layout: heads (axis 2) shard over the ``model`` mesh axis —
+    the cache shard each rank sees inside shard_map holds exactly the
+    heads its qkv column shard produces. Lengths are replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    kv = P(None, None, ps.TENSOR_AXIS, None, None)
+    return KVCache(k=kv, v=kv, lengths=P())

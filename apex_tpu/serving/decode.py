@@ -1,0 +1,241 @@
+"""Prefill + single-token decode steps over the KV cache.
+
+Two execution paths from one body (the ``models/gpt.py`` discipline):
+``make_prefill_fn``/``make_decode_fn`` are plain-jnp on full params (the
+golden single-chip path); ``make_tp_prefill_fn``/``make_tp_decode_fn``
+run the same body inside ``parallel_state.shard_map`` with the Megatron
+TP layers — heads (and the cache's head axis) shard over the ``model``
+mesh axis, and logits leave through the existing ``_tied_lm_logits``
+vocab-sharded head followed by a rank-order gather, so every rank
+returns the full ``(b, V)`` row.
+
+Contracts:
+
+- **prefill** runs the full forward ONCE over a (bucket-padded) prompt
+  for one slot, writes that slot's K/V rows (+ the slot length), and
+  returns the logits at the LAST REAL token — the first sampling input.
+  The pad tail is masked out of attention (`key_mask`) and zeroed
+  before entering the cache, so pad K/V can never be attended to, now
+  or after later in-place writes.
+- **decode** advances every slot one token: writes the new K/V row at
+  ``pos = lengths`` and attends with an ``s <= pos`` mask. Its logits
+  must match a full-sequence forward at the same positions to fp32
+  tolerance (the headline serving contract; see
+  ``tests/L0/run_serving``).
+- both jitted steps DONATE the cache: the update lowers to an in-place
+  buffer write instead of a fresh ``O(L·B·H·S·d)`` copy per token.
+  APX512 (trace tier) verifies the donation survives into the jaxpr.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.models.gpt import (
+    GPTConfig, GPTModel, _block_decode, _block_prefill, _ln,
+    _rope_or_none, _tied_lm_logits,
+)
+from apex_tpu.serving.cache import KVCache, cache_partition_specs
+
+
+# ---------------------------------------------------------------------------
+# shared cores (parameterized by the linear/embedding/logits impls)
+# ---------------------------------------------------------------------------
+
+def _prefill_core(params, cfg: GPTConfig, cache: KVCache, ids, mask,
+                  slot, *, embed_fn, dense_fns, logits_fn):
+    """ids (1, s_bucket) already bucket-padded; mask (s_bucket,) int32
+    with 1 = real token (``utils.seqlen.pad_to_bucket``'s convention);
+    slot: scalar int32 cache row. Returns (cache', logits (1, V))."""
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(f"prefill takes one slot's (1, s) ids, got "
+                         f"{ids.shape}")
+    s = ids.shape[1]
+    if s > cache.k.shape[3]:
+        raise ValueError(f"prompt bucket {s} exceeds cache max_len "
+                         f"{cache.k.shape[3]}")
+    x = embed_fn(params, ids)
+    freqs = _rope_or_none(cfg, s)
+    key_mask = mask[None, :]
+
+    def body(x, lp):
+        x, k, v = _block_prefill(lp, x, cfg, freqs, key_mask, *dense_fns)
+        return x, (k, v)
+
+    x, (k, v) = lax.scan(body, x, params["layers"])
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    length = jnp.sum(mask).astype(jnp.int32)
+    h_last = lax.dynamic_slice_in_dim(hidden, length - 1, 1, 1)[:, 0]
+    logits = logits_fn(params, h_last)
+    # zero the pad tail before it enters the cache: decode's s <= pos
+    # mask already can't reach rows past `length`, but zeroed rows make
+    # the cache contents independent of pad ids outright (and keep the
+    # donation bit-identity tests deterministic)
+    mz = mask.astype(k.dtype)[None, None, None, :, None]
+    new = KVCache(
+        k=lax.dynamic_update_slice(cache.k, (k * mz).astype(cache.k.dtype),
+                                   (0, slot, 0, 0, 0)),
+        v=lax.dynamic_update_slice(cache.v, (v * mz).astype(cache.v.dtype),
+                                   (0, slot, 0, 0, 0)),
+        lengths=lax.dynamic_update_slice(cache.lengths, length[None],
+                                         (slot,)))
+    return new, logits
+
+
+def _decode_core(params, cfg: GPTConfig, cache: KVCache, tokens, active,
+                 *, embed_fn, dense_fns, logits_fn):
+    """tokens (B,) int32 — each slot's previous token; active (B,) bool
+    gates the length advance (freed slots stay parked). Returns
+    (cache', logits (B, V) fp32)."""
+    pos = cache.lengths
+    x = embed_fn(params, tokens[:, None], pos=pos)
+    freqs = _rope_or_none(cfg, cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kc, vc = layer_slice
+        x, kc, vc = _block_decode(lp, x, kc, vc, pos, cfg, freqs,
+                                  *dense_fns)
+        return x, (kc, vc)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden[:, 0])
+    return KVCache(k, v, jnp.where(active, pos + 1, pos)), logits
+
+
+# ---------------------------------------------------------------------------
+# unsharded (single-chip) builders
+# ---------------------------------------------------------------------------
+
+def _dense(p, x):
+    return jnp.dot(x, p["kernel"].astype(x.dtype)) \
+        + p["bias"].astype(x.dtype)
+
+
+def _embed_unsharded(cfg: GPTConfig, compute_dtype):
+    def embed(params, ids, pos=None):
+        table = params["embedding"]["word"]["embedding"]
+        if compute_dtype is not None:
+            table = table.astype(compute_dtype)
+        x = jnp.take(table, ids, axis=0)
+        if not cfg.use_rope:
+            ptab = params["embedding"]["position"]["embedding"]
+            if pos is None:
+                x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
+            else:
+                # decode: each slot sits at its own absolute position
+                x = x + jnp.take(ptab, pos, axis=0).astype(
+                    x.dtype)[:, None, :]
+        return x
+    return embed
+
+
+def _logits_unsharded(params, hidden):
+    table = params["embedding"]["word"]["embedding"]
+    return jnp.dot(hidden, table.astype(hidden.dtype).T).astype(
+        jnp.float32)
+
+
+def make_prefill_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(prefill) with the cache DONATED. One compiled executable per
+    (bucket length, cache shape) — call through a bucketing layer (the
+    scheduler does) so recompiles are per bucket, never per request."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def prefill(params, cache, ids, mask, slot):
+        return _prefill_core(params, cfg, cache, ids, mask, slot,
+                             embed_fn=embed, dense_fns=(_dense,) * 4,
+                             logits_fn=_logits_unsharded)
+
+    return jax.jit(prefill, donate_argnums=1)
+
+
+def make_decode_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(decode) with the cache DONATED; compiles once per cache
+    shape (batch of slots advances together)."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def decode(params, cache, tokens, active):
+        return _decode_core(params, cfg, cache, tokens, active,
+                            embed_fn=embed, dense_fns=(_dense,) * 4,
+                            logits_fn=_logits_unsharded)
+
+    return jax.jit(decode, donate_argnums=1)
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded builders — heads (and the cache head axis) over ``model``
+# ---------------------------------------------------------------------------
+
+def _tp_fns(model: GPTModel):
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    cfg = model.cfg
+
+    def embed(params, ids, pos=None):
+        x = model.embed.apply(params["embedding"]["word"], ids)
+        if not cfg.use_rope:
+            ptab = params["embedding"]["position"]["embedding"]
+            if pos is None:
+                x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
+            else:
+                x = x + jnp.take(ptab, pos, axis=0).astype(
+                    x.dtype)[:, None, :]
+        return x
+
+    def logits(params, hidden):
+        local = _tied_lm_logits(hidden,
+                                params["embedding"]["word"]["embedding"])
+        # rank-order gather -> the full vocab row on every rank (the
+        # serving head wants a samplable (b, V), unlike training's
+        # vocab-parallel CE which keeps logits sharded)
+        return mappings.gather_from_tensor_model_parallel_region(local)
+
+    dense_fns = (model.qkv.apply, model.out.apply, model.fc1.apply,
+                 model.fc2.apply)
+    return embed, dense_fns, logits
+
+
+def make_tp_prefill_fn(model: GPTModel, mesh=None):
+    """TP prefill: ``jit(shard_map(...))`` over the global mesh, cache
+    donated. Params use ``model.partition_specs()``; the cache uses
+    ``cache_partition_specs()`` (heads over ``model``)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = cache_partition_specs()
+
+    def prefill(params, cache, ids, mask, slot):
+        return _prefill_core(params, cfg, cache, ids, mask, slot,
+                             embed_fn=embed, dense_fns=dense_fns,
+                             logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        prefill, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P(), P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_decode_fn(model: GPTModel, mesh=None):
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = cache_partition_specs()
+
+    def decode(params, cache, tokens, active):
+        return _decode_core(params, cfg, cache, tokens, active,
+                            embed_fn=embed, dense_fns=dense_fns,
+                            logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        decode, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)

@@ -1,0 +1,34 @@
+"""Token sampling under explicit PRNG keys.
+
+Serving needs reproducible sampling: every stochastic draw threads an
+explicit ``jax.random`` key (the scheduler derives per-slot keys as
+``fold_in(PRNGKey(request.seed), step)``), so a replayed request stream
+regenerates byte-identical outputs — the determinism contract the
+training side already holds (see ``tests/L0/run_serving``).
+
+One fused entry point handles the whole batch: per-slot temperature
+(``<= 0`` selects greedy) so mixed greedy/sampled slots decode in one
+jitted step instead of recompiling per request mix. ``top_k`` is static
+(part of the compiled program) — it is an engine-level setting, not a
+per-request one.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits: jax.Array, keys: jax.Array,
+                  temperature: jax.Array, top_k: int = 0) -> jax.Array:
+    """logits (B, V) fp32; keys (B, 2) uint32 (stacked jax.random keys);
+    temperature (B,) float — ``t <= 0`` means greedy for that slot, the
+    scheduler's encoding for deterministic requests. ``top_k`` (static;
+    0 = full vocab) restricts sampling to each row's k largest logits.
+    Returns (B,) int32 token ids."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(
+        jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

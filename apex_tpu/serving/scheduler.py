@@ -1,0 +1,187 @@
+"""Continuous batching over a fixed-slot KV cache.
+
+The scheduler is the host-side half of serving: a FIFO of requests is
+multiplexed onto ``num_slots`` cache rows. A slot is admitted with one
+bucketed prefill (compiling once per bucket length, never per request),
+then every tick advances ALL occupied slots with a single decode step;
+a slot is evicted the moment it emits EOS, hits its ``max_new_tokens``,
+or fills its cache row — and the freed row is re-admitted from the
+queue on the same tick. The decode step therefore always runs at the
+full slot batch and only two executables exist in steady state: one
+decode program plus one prefill program per touched bucket.
+
+Determinism: every sampled token draws from
+``fold_in(PRNGKey(request.seed), n_generated)`` — replaying the same
+request stream regenerates identical outputs regardless of how requests
+interleave across slots.
+
+The engine's cache is DONATED to each jitted step (see
+``serving.decode``); ``DecodeEngine`` immediately rebinds
+``self.cache``, so never hold a stale reference to it across a step.
+"""
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.serving.cache import init_cache
+from apex_tpu.serving.decode import make_decode_fn, make_prefill_fn
+from apex_tpu.serving.sampling import sample_tokens
+from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. ``temperature <= 0`` means greedy;
+    ``seed`` roots this request's PRNG stream (independent of slot
+    placement and co-tenants)."""
+    prompt: Tuple[int, ...]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    request: Request
+    prompt_len: int
+    generated: List[int]
+    pos: int            # cache rows written (prompt + decode steps)
+
+
+class DecodeEngine:
+    """Owns the params, the cache, and the three jitted programs
+    (bucketed prefill, batched decode, sampling). ``top_k`` is static —
+    an engine setting, compiled into the sampler."""
+
+    def __init__(self, params, cfg: GPTConfig, num_slots: int,
+                 max_len: int, cache_dtype=jnp.bfloat16, top_k: int = 0,
+                 buckets: Optional[Sequence[int]] = None,
+                 compute_dtype=None):
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        if buckets is None:
+            buckets = default_buckets(max_len, min(128, max_len))
+        # clamp the ladder to the cache: prefill rejects buckets beyond
+        # S_max, and the top-of-ladder bucket may overshoot max_len
+        self.buckets = tuple(sorted({min(int(b), max_len)
+                                     for b in buckets}))
+        self.top_k = top_k
+        self.cache = init_cache(cfg, num_slots, max_len, cache_dtype)
+        self._prefill = make_prefill_fn(cfg, compute_dtype)
+        self._decode = make_decode_fn(cfg, compute_dtype)
+        self._sample = jax.jit(sample_tokens, static_argnames="top_k")
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> jax.Array:
+        """Run the full forward over ``prompt`` into cache row ``slot``;
+        returns the last-real-token logits (1, V)."""
+        ids = np.asarray(prompt, np.int32)[None, :]
+        ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
+        self.cache, logits = self._prefill(
+            self.params, self.cache, ids, mask, jnp.int32(slot))
+        return logits
+
+    def decode(self, tokens: jax.Array, active: jax.Array) -> jax.Array:
+        """One token for every slot; ``active`` gates length advance.
+        Returns (num_slots, V) fp32 logits."""
+        self.cache, logits = self._decode(self.params, self.cache,
+                                          tokens, active)
+        return logits
+
+    def sample(self, logits, keys, temperature) -> jax.Array:
+        return self._sample(logits, keys, temperature, top_k=self.top_k)
+
+
+class ContinuousBatchingScheduler:
+    """FIFO → fixed slots → batched decode ticks (see module doc)."""
+
+    def __init__(self, engine: DecodeEngine, eos_id: int):
+        self.engine = engine
+        self.eos_id = eos_id
+        self._queue: deque = deque()
+        self._slots: List[Optional[_Slot]] = [None] * engine.num_slots
+        self._results: dict = {}
+        self._next_id = 0
+
+    def submit(self, request: Request) -> int:
+        if not len(request.prompt):
+            raise ValueError("empty prompt")
+        if len(request.prompt) > self.engine.max_len:
+            raise ValueError(
+                f"prompt length {len(request.prompt)} exceeds cache "
+                f"max_len {self.engine.max_len}")
+        # fail fast at submit, not mid-run inside _admit
+        bucket_for(len(request.prompt), self.engine.buckets)
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append((rid, request))
+        return rid
+
+    def _slot_key(self, slot: _Slot) -> jax.Array:
+        return jax.random.fold_in(
+            jax.random.PRNGKey(slot.request.seed), len(slot.generated))
+
+    def _admit(self) -> None:
+        eng = self.engine
+        for i in range(eng.num_slots):
+            if self._slots[i] is not None or not self._queue:
+                continue
+            rid, req = self._queue.popleft()
+            slot = _Slot(rid, req, len(req.prompt), [], len(req.prompt))
+            logits = eng.prefill(i, req.prompt)
+            # the FIRST generated token comes from the prefill logits
+            tok = int(eng.sample(
+                logits, self._slot_key(slot)[None, :],
+                jnp.asarray([req.temperature], jnp.float32))[0])
+            slot.generated.append(tok)
+            self._slots[i] = slot
+            self._maybe_evict(i)
+
+    def _maybe_evict(self, i: int) -> None:
+        slot = self._slots[i]
+        done = (slot.generated[-1] == self.eos_id
+                or len(slot.generated) >= slot.request.max_new_tokens
+                or slot.pos >= self.engine.max_len)  # cache row full
+        if done:
+            self._results[slot.request_id] = list(slot.generated)
+            self._slots[i] = None
+
+    def _tick(self) -> None:
+        eng = self.engine
+        occupied = [s for s in self._slots if s is not None]
+        if not occupied:
+            return
+        tokens = jnp.asarray(
+            [s.generated[-1] if s else 0 for s in self._slots],
+            jnp.int32)
+        active = jnp.asarray([s is not None for s in self._slots])
+        temps = jnp.asarray(
+            [s.request.temperature if s else 0.0 for s in self._slots],
+            jnp.float32)
+        keys = jnp.stack(
+            [self._slot_key(s) if s else jax.random.PRNGKey(0)
+             for s in self._slots])
+        logits = eng.decode(tokens, active)
+        next_tokens = np.asarray(eng.sample(logits, keys, temps))
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.generated.append(int(next_tokens[i]))
+            slot.pos += 1
+            self._maybe_evict(i)
+
+    def run(self) -> List[List[int]]:
+        """Drain the queue; returns generated tokens (EOS included when
+        emitted) per request, in submission order."""
+        while self._queue or any(s is not None for s in self._slots):
+            self._admit()
+            self._tick()
+        return [self._results[rid] for rid in sorted(self._results)]

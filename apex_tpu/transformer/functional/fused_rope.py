@@ -125,12 +125,27 @@ def fused_apply_rotary_pos_emb_bshd(t: jax.Array,
     return _rope_core(t, cos[None, :, 0], sin[None, :, 0])
 
 
-def fused_apply_rotary_pos_emb_bhsd(t: jax.Array,
-                                    freqs: jax.Array) -> jax.Array:
-    """(b, h, s, d) layout wrapper — the in-tree models' attention layout."""
+def fused_apply_rotary_pos_emb_bhsd(t: jax.Array, freqs: jax.Array,
+                                    positions: Optional[jax.Array] = None
+                                    ) -> jax.Array:
+    """(b, h, s, d) layout wrapper — the in-tree models' attention layout.
+
+    ``positions`` (optional, (b,) integer array, traced is fine) selects
+    each batch row's ABSOLUTE rotation angles from the ``freqs`` table:
+    row ``i`` of ``t`` is rotated as if its ``s`` query positions were
+    ``positions[i], positions[i]+1, ...``. This is the incremental-decode
+    entry point: a single-token query (s=1) at cache offset ``p`` must be
+    rotated by θ_p, not θ_0, and the offset differs per batch slot. The
+    default (``positions=None``) keeps the training convention — angles
+    are rows ``0..s-1`` of the table, shared across the batch."""
     cos = jnp.cos(freqs).reshape(freqs.shape[0], freqs.shape[-1])
     sin = jnp.sin(freqs).reshape(freqs.shape[0], freqs.shape[-1])
-    return _rope_core(t, cos[None, None], sin[None, None])
+    if positions is None:
+        return _rope_core(t, cos[None, None], sin[None, None])
+    # (b, s) absolute positions -> gathered (b, 1, s, d) angle factors
+    # broadcasting over the head axis of t (b, h, s, d)
+    idx = positions[:, None] + jnp.arange(t.shape[2])[None, :]
+    return _rope_core(t, cos[idx][:, None], sin[idx][:, None])
 
 
 def rope_cos_sin(dim: int, seq_len: int, base: float = 10000.0,

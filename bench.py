@@ -515,6 +515,97 @@ def bench_tp_gpt(on_tpu):
                 "step_ms": round(dt * 1e3, 2)})
 
 
+# -- serving: batched KV-cached decode --------------------------------------
+
+def _decode_bench_setup(on_tpu, cache_dtype, slots=None):
+    """(body, make_init, fetch, slots, s_max): one greedy decode step
+    over the serving KV cache for every slot — the steady-state
+    continuous-batching inner loop, no host scheduler in the timed
+    region. Lengths park mid-cache and reset before reaching the end so
+    a scan chunk of any length measures the same in-range program."""
+    import dataclasses
+
+    from apex_tpu.models.gpt import GPTConfig, gpt_tiny, init_gpt
+    from apex_tpu.serving.cache import init_cache
+    from apex_tpu.serving.decode import (
+        _decode_core, _dense, _embed_unsharded, _logits_unsharded,
+    )
+
+    if on_tpu:
+        # gpt_medium-class decode on one chip; bf16 params (inference)
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        ffn_hidden_size=4096, vocab_size=50304,
+                        max_position_embeddings=1024, use_rope=True,
+                        hidden_dropout=0.0)
+        slots = 32 if slots is None else slots
+        s_max = 1024
+    else:
+        cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                                  hidden_dropout=0.0)
+        slots = 4 if slots is None else slots
+        s_max = 64
+    embed = _embed_unsharded(cfg, None)
+    active = jnp.ones((slots,), bool)
+
+    def make_init():
+        params = init_gpt(jax.random.PRNGKey(0), cfg,
+                          jnp.bfloat16 if on_tpu else jnp.float32)
+        cache = init_cache(cfg, slots, s_max, cache_dtype)
+        cache = cache._replace(
+            lengths=jnp.full((slots,), s_max // 2, jnp.int32))
+        return params, cache, jnp.zeros((slots,), jnp.int32)
+
+    def body(state):
+        params, cache, tokens = state
+        cache = cache._replace(lengths=jnp.where(
+            cache.lengths >= s_max - 1, jnp.int32(s_max // 2),
+            cache.lengths))
+        cache, logits = _decode_core(
+            params, cfg, cache, tokens, active, embed_fn=embed,
+            dense_fns=(_dense,) * 4, logits_fn=_logits_unsharded)
+        return params, cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    fetch = lambda s: (jnp.sum(s[1].lengths)  # noqa: E731
+                       + jnp.sum(s[2])).astype(jnp.float32)
+    return body, make_init, fetch, slots, s_max
+
+
+def bench_gpt_decode(on_tpu):
+    body, make_init, fetch, slots, s_max = _decode_bench_setup(
+        on_tpu, jnp.bfloat16)
+    dt = timed(body, make_init, fetch, M=20 if on_tpu else 2,
+               donate=True)
+    metric = "gpt_decode_tokens_per_s"
+    extra = {}
+    # same run-went-off-the-rails gate as the headline: throughput
+    # metrics can't reuse checked()'s time-scale comparison
+    prior = [v for v in _recorded_values(metric) if v]
+    from apex_tpu.utils.platform import has_tpu
+    if prior and has_tpu():
+        if not (1 / 3.0 < (slots / dt) / prior[-1] < 3.0):
+            first = slots / dt
+            dt = min(dt, timed(body, make_init, fetch, M=20,
+                               donate=True))
+            extra = {"retried": True, "first": round(first, 2)}
+    extra.update({"slots": slots, "seq_max": s_max,
+                  "cache_dtype": "bfloat16",
+                  "per_token_latency_ms": round(dt * 1e3, 3)})
+    emit(metric, slots / dt, "tokens/sec", extra=extra)
+
+
+def _decode_cache_ab_pair(on_tpu):
+    """(side_a, side_b): bf16 vs fp32 KV cache on the batched decode
+    step — prices the cache-HBM halving the serving default banks on.
+    Smaller slot count than the driver metric: the non-donating A/B
+    harness holds both sides' caches (and two copies each) live."""
+    def side(dtype):
+        body, make_init, fetch, _, _ = _decode_bench_setup(
+            on_tpu, dtype, slots=8 if on_tpu else 2)
+        return _ab_side(body, make_init(), fetch, M=10 if on_tpu else 2)
+
+    return side(jnp.bfloat16), side(jnp.float32)
+
+
 # -- flash-attention microbench: kernel vs unfused at long seq --------------
 
 def bench_flash_attention(on_tpu):
@@ -878,6 +969,9 @@ AB_PAIRS = {
     "adam_small_tensors_pollution": (
         "fresh", "polluted",
         _small_tensor_pollution_pair, "sequential"),
+    "decode_cache_bf16": (
+        "cache_bf16", "cache_fp32",
+        _decode_cache_ab_pair),
 }
 
 
@@ -1326,6 +1420,7 @@ CONFIGS = {
     "kernel_parity": bench_kernel_parity,
     "ab_kernels": bench_ab,
     "headline": bench_headline,
+    "gpt_decode": bench_gpt_decode,
 }
 
 # Driver execution order (round-4 postmortem). The HEADLINE runs FIRST:
@@ -1336,9 +1431,9 @@ CONFIGS = {
 # r4's 27x seq2048 anomaly, which followed two GPT OOMs). The headline
 # line is RE-EMITTED at the very end so the driver's parse-the-tail
 # convention still lands on the contract metric.
-ORDER = ["headline", "kernel_parity", "flash_attention", "ab_kernels",
-         "layer_norm", "opt_adam", "opt_lamb", "opt_flat_vs_tree",
-         "ddp_bert", "tp_gpt"]
+ORDER = ["headline", "gpt_decode", "kernel_parity", "flash_attention",
+         "ab_kernels", "layer_norm", "opt_adam", "opt_lamb",
+         "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
 
 # Global wall budget (seconds) with per-config caps: the driver must see
 # a finished run. Generous-but-bounded; BENCH_BUDGET_S overrides. Cap
@@ -1348,7 +1443,8 @@ ORDER = ["headline", "kernel_parity", "flash_attention", "ab_kernels",
 # caps are ~2x the observed wall of each config.
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2700"))
 CAP_S = {"headline": 600, "kernel_parity": 480, "ddp_bert": 540,
-         "tp_gpt": 600, "flash_attention": 540, "ab_kernels": 540}
+         "tp_gpt": 600, "flash_attention": 540, "ab_kernels": 540,
+         "gpt_decode": 420}
 DEFAULT_CAP_S = 480
 
 

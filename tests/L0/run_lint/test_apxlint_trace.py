@@ -312,6 +312,31 @@ def test_apx512_dropped_pairs():
     assert len(msgs) == 1 and "dropped" in msgs[0]
 
 
+def _b512_donation_clean():
+    # a donated buffer with a same-aval output: the donation lands and
+    # counts toward min_alias_pairs
+    step = jax.jit(lambda c, x: (c + x, jnp.sum(x)), donate_argnums=0)
+    fn = lambda c, x: step(c, x)
+    return fn, (_sds((64, 32), "float32"), _sds((64, 32), "float32"))
+
+
+def _b512_donation_orphaned():
+    # the donated operand has no shape/dtype-matching output — XLA
+    # silently discards the donation
+    step = jax.jit(lambda c, x: jnp.sum(c + x), donate_argnums=0)
+    fn = lambda c, x: step(c, x)
+    return fn, (_sds((64, 32), "float32"), _sds((64, 32), "float32"))
+
+
+def test_apx512_donation_counts_toward_pairs():
+    assert _codes([_alias_entry("don", _b512_donation_clean, 1)]) == []
+
+
+def test_apx512_orphaned_donation_fires():
+    msgs = _msgs([_alias_entry("orphan", _b512_donation_orphaned, 0)])
+    assert len(msgs) == 1 and "discards the donation" in msgs[0], msgs
+
+
 # ---------------------------------------------------------------------------
 # seeded-bug meta-tests over scratch copies of real modules
 # ---------------------------------------------------------------------------

@@ -1,0 +1,180 @@
+"""Serving headline contract: KV-cached incremental decode must match
+the full-sequence forward to fp32 tolerance at identical positions —
+plus the supporting invariants (pad-independence of bucketed prefill,
+cache-donation bit-identity, cache dtype behavior)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt import apply_gpt_unsharded, gpt_tiny, init_gpt
+from apex_tpu.serving import init_cache, make_decode_fn, make_prefill_fn
+from apex_tpu.serving.cache import KVCache
+
+S_TOTAL, PROMPT, S_MAX = 20, 8, 32
+
+
+def _cfg(use_rope):
+    return dataclasses.replace(gpt_tiny(), use_rope=use_rope,
+                               hidden_dropout=0.0)
+
+
+def _full_logits(params, cfg, seq):
+    hidden = apply_gpt_unsharded(params, cfg, seq)
+    table = params["embedding"]["word"]["embedding"]
+    return jnp.dot(hidden, table.T).astype(jnp.float32)
+
+
+def _teacher_forced(params, cfg, seq, cache_dtype=jnp.float32,
+                    num_slots=2):
+    """prefill(seq[:PROMPT]) then decode feeding the TRUE next tokens;
+    returns logits rows aligned with positions PROMPT-1 .. S_TOTAL-1."""
+    prefill = make_prefill_fn(cfg)
+    decode = make_decode_fn(cfg)
+    cache = init_cache(cfg, num_slots, S_MAX, cache_dtype)
+    cache, logits = prefill(params, cache, seq[:, :PROMPT],
+                            jnp.ones((PROMPT,), jnp.int32),
+                            jnp.int32(0))
+    rows = [logits[0]]
+    pad_tokens = jnp.zeros((num_slots - 1,), jnp.int32)
+    active = jnp.asarray([True] + [False] * (num_slots - 1))
+    for t in range(PROMPT, seq.shape[1]):
+        tokens = jnp.concatenate(
+            [jnp.asarray([int(seq[0, t])], jnp.int32), pad_tokens])
+        cache, logits = decode(params, cache, tokens, active)
+        rows.append(logits[0])
+    return jnp.stack(rows)
+
+
+@pytest.mark.parametrize("use_rope", [True, False],
+                         ids=["rope", "learned_pos"])
+def test_decode_matches_full_forward(use_rope):
+    cfg = _cfg(use_rope)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = jax.random.randint(jax.random.PRNGKey(1), (1, S_TOTAL), 0,
+                             cfg.vocab_size)
+    want = _full_logits(params, cfg, seq)[0, PROMPT - 1:]
+    got = _teacher_forced(params, cfg, seq)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_prefill_pad_tail_never_attended():
+    """Bucket padding regression: prefill of the same prompt padded with
+    two different garbage tails must produce identical logits AND an
+    identical cache — pad K/V can never leak into attention, now or
+    through later in-place cache writes."""
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    prefill = make_prefill_fn(cfg)
+    prompt = np.asarray([[5, 7, 11, 13, 17]], np.int32)  # ragged: 5
+    bucket = 16
+    mask = (np.arange(bucket) < prompt.shape[1]).astype(np.int32)
+
+    def run(pad_value):
+        ids = np.full((1, bucket), pad_value, np.int32)
+        ids[:, : prompt.shape[1]] = prompt
+        cache = init_cache(cfg, 1, S_MAX, jnp.float32)
+        return prefill(params, cache, jnp.asarray(ids),
+                       jnp.asarray(mask), jnp.int32(0))
+
+    cache_a, logits_a = run(0)
+    cache_b, logits_b = run(499)
+    np.testing.assert_array_equal(np.asarray(logits_a),
+                                  np.asarray(logits_b))
+    for a, b in zip(cache_a, cache_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the decode continuation is identical too
+    decode = make_decode_fn(cfg)
+    _, la = decode(params, cache_a, jnp.asarray([3], jnp.int32),
+                   jnp.asarray([True]))
+    _, lb = decode(params, cache_b, jnp.asarray([3], jnp.int32),
+                   jnp.asarray([True]))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_ragged_batch_parity():
+    """Prompts of different lengths, each bucketed with a pad tail, all
+    decoding concurrently in one cache — every slot must still match
+    its own full-sequence forward."""
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    prefill = make_prefill_fn(cfg)
+    decode = make_decode_fn(cfg)
+    lens = [3, 8, 13]
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, cfg.vocab_size, size=(1, n + 4)).astype(
+        np.int32) for n in lens]
+    cache = init_cache(cfg, len(lens), S_MAX, jnp.float32)
+    for i, (n, seq) in enumerate(zip(lens, seqs)):
+        bucket = 16
+        ids = np.zeros((1, bucket), np.int32)
+        ids[:, :n] = seq[:, :n]
+        mask = (np.arange(bucket) < n).astype(np.int32)
+        cache, logits = prefill(params, cache, jnp.asarray(ids),
+                                jnp.asarray(mask), jnp.int32(i))
+        want = _full_logits(params, cfg, jnp.asarray(seq[:, :n]))
+        np.testing.assert_allclose(np.asarray(logits[0]),
+                                   np.asarray(want[0, -1]),
+                                   rtol=1e-4, atol=1e-4)
+    # four teacher-forced decode steps with ALL slots active at their
+    # own (ragged) positions
+    for t in range(4):
+        tokens = jnp.asarray([int(s[0, n + t]) for n, s in
+                              zip(lens, seqs)], jnp.int32)
+        cache, logits = decode(params, cache, tokens,
+                               jnp.ones((len(lens),), bool))
+        for i, (n, seq) in enumerate(zip(lens, seqs)):
+            want = _full_logits(params, cfg,
+                                jnp.asarray(seq[:, : n + t + 1]))
+            np.testing.assert_allclose(np.asarray(logits[i]),
+                                       np.asarray(want[0, -1]),
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_cache_donation_bit_identity():
+    """The donated jitted decode must produce bit-identical caches and
+    logits to a fresh-cache run of the same steps — donation is a
+    buffer-reuse optimization, never a numerics change."""
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    prefill = make_prefill_fn(cfg)
+    decode = make_decode_fn(cfg)
+
+    def run_steps():
+        cache = init_cache(cfg, 1, S_MAX, jnp.bfloat16)
+        cache, _ = prefill(params, cache,
+                           jnp.asarray([[2, 3, 5, 7]], jnp.int32),
+                           jnp.ones((4,), jnp.int32), jnp.int32(0))
+        outs = []
+        for tok in (11, 13, 17):
+            cache, logits = decode(params, cache,
+                                   jnp.asarray([tok], jnp.int32),
+                                   jnp.asarray([True]))
+            outs.append(np.asarray(logits))
+        return cache, outs
+
+    cache_a, outs_a = run_steps()
+    cache_b, outs_b = run_steps()
+    for a, b in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(cache_a, cache_b):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32))
+    assert cache_a.k.dtype == jnp.bfloat16
+    assert int(cache_a.lengths[0]) == 7  # 4 prompt + 3 decoded
+
+
+def test_init_cache_validates():
+    cfg = _cfg(False)
+    with pytest.raises(ValueError, match="position table"):
+        init_cache(cfg, 1, cfg.max_position_embeddings + 1)
+    with pytest.raises(ValueError, match="positive"):
+        init_cache(cfg, 0, 8)
+    c = init_cache(cfg, 2, 16)
+    assert isinstance(c, KVCache) and c.k.dtype == jnp.bfloat16
+    assert c.k.shape == (cfg.num_layers, 2, cfg.num_heads, 16,
+                         cfg.head_dim)

@@ -1,0 +1,72 @@
+"""sample_tokens: greedy/temperature selection, top-k support
+restriction, and determinism under explicit PRNG keys."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving import sample_tokens
+
+V = 64
+
+
+def _logits(n, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (n, V),
+                             jnp.float32)
+
+
+def _keys(n, seed=7):
+    return jax.random.split(jax.random.PRNGKey(seed), n)
+
+
+def test_greedy_is_argmax():
+    logits = _logits(4)
+    out = sample_tokens(logits, _keys(4), jnp.zeros((4,), jnp.float32))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_greedy_ignores_keys():
+    logits = _logits(3)
+    temps = jnp.zeros((3,), jnp.float32)
+    a = sample_tokens(logits, _keys(3, seed=1), temps)
+    b = sample_tokens(logits, _keys(3, seed=2), temps)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sampling_deterministic_per_key():
+    logits = _logits(4)
+    temps = jnp.full((4,), 0.9, jnp.float32)
+    a = sample_tokens(logits, _keys(4), temps)
+    b = sample_tokens(logits, _keys(4), temps)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = sample_tokens(logits, _keys(4, seed=99), temps)
+    assert (np.asarray(a) != np.asarray(c)).any()
+
+
+def test_top_k_restricts_support():
+    logits = _logits(8, seed=3)
+    k = 5
+    temps = jnp.full((8,), 1.3, jnp.float32)
+    allowed = np.asarray(jnp.argsort(logits, -1)[:, -k:])
+    for seed in range(4):
+        out = np.asarray(sample_tokens(logits, _keys(8, seed=seed),
+                                       temps, top_k=k))
+        for i, tok in enumerate(out):
+            assert tok in allowed[i]
+
+
+def test_top_k_one_is_argmax():
+    logits = _logits(4, seed=5)
+    out = sample_tokens(logits, _keys(4), jnp.ones((4,), jnp.float32),
+                        top_k=1)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_mixed_greedy_and_sampled_rows():
+    logits = _logits(4, seed=6)
+    temps = jnp.asarray([0.0, 1.0, 0.0, 1.0], jnp.float32)
+    out = np.asarray(sample_tokens(logits, _keys(4), temps))
+    greedy = np.asarray(jnp.argmax(logits, -1))
+    assert out[0] == greedy[0] and out[2] == greedy[2]
