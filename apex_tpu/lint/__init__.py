@@ -31,6 +31,9 @@ CODES = {
               "+ scratch) exceeds the per-kernel budget",
     "APX103": "flash/softmax statistics tile (m, l, lse, mean, rstd) "
               "stored or allocated below fp32",
+    "APX105": "pallas_call kernel family has no APX102 VMEM registry "
+              "config and/or no TraceEntry in the trace registry (new "
+              "kernels must register in both trace-time tiers)",
     "APX201": "collective sequence diverges across the branches of a "
               "rank-dependent conditional (multi-chip deadlock)",
     "APX202": "collective axis name does not resolve to a "
@@ -62,6 +65,15 @@ CODES = {
     "APX512": "declared input_output_aliases pair does not survive "
               "into the traced jaxpr (severed provenance, dtype/shape "
               "mismatch, or dropped pair) — HBM traffic doubles",
+    "APX601": "entry's static HBM traffic exceeds its budgets.json "
+              "ceiling (memory-traffic regression)",
+    "APX602": "entry's static HBM traffic drifted outside the "
+              "tolerance band without a budgets.json update (or the "
+              "manifest is missing/stale)",
+    "APX603": "entry's static collective volume differs from the "
+              "budgets.json record (communication schedule changed)",
+    "APX604": "entry's peak-live-bytes estimate exceeds its "
+              "budgets.json cap",
 }
 
 

@@ -5,7 +5,11 @@ suppression — suitable as a blocking CI step. ``--no-trace`` skips the
 trace-time VMEM budget pass (APX102) for a pure-AST run that needs no
 jax import; ``--trace`` additionally runs the jaxpr-level trace tier
 (APX501/502/503/511/512) over the ``apex_tpu.lint.traced`` entry
-registry; ``--select`` narrows to a comma-separated code list.
+registry; ``--cost`` runs the APX6xx cost tier (static HBM-traffic /
+collective-volume budgets vs ``budgets.json`` — combine with
+``--report`` to dump the per-entry table as JSON on stdout with
+findings on stderr, or ``--write-budgets`` to regenerate the
+manifest); ``--select`` narrows to a comma-separated code list.
 """
 
 import argparse
@@ -28,6 +32,17 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="also run the jaxpr trace tier (APX5xx) over "
                          "the registered entrypoints")
+    ap.add_argument("--cost", action="store_true",
+                    help="also run the APX6xx cost tier: per-entry "
+                         "static HBM/collective byte budgets vs "
+                         "budgets.json")
+    ap.add_argument("--report", action="store_true",
+                    help="with --cost: print the per-entry cost table "
+                         "as JSON to stdout (findings go to stderr)")
+    ap.add_argument("--write-budgets", action="store_true",
+                    help="retrace the registry and regenerate "
+                         "budgets.json (hand-tightened ceilings/caps "
+                         "are preserved), then exit")
     ap.add_argument("--select", default=None, metavar="CODES",
                     help="comma-separated codes to report "
                          "(e.g. APX101,APX201)")
@@ -42,6 +57,23 @@ def main(argv=None) -> int:
             print(f"{code}  {doc}")
         return 0
 
+    if args.write_budgets:
+        from apex_tpu.lint.traced import budgets, registry
+
+        registry.ensure_cpu_devices()
+        reports = []
+        findings = registry.run_entries(registry.repo_entries(),
+                                        run_checks=False,
+                                        cost_out=reports)
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        if findings:  # refuse to pin budgets from a broken trace
+            return 1
+        manifest = budgets.write_manifest(reports)
+        print(f"apxlint: wrote {budgets.manifest_path()} "
+              f"({len(manifest['entries'])} entries)")
+        return 0
+
     select = None
     if args.select:
         select = {c.strip().upper() for c in args.select.split(",") if
@@ -53,19 +85,29 @@ def main(argv=None) -> int:
             return 2
 
     paths = args.paths or ["apex_tpu"]
+    reports: list = []
     findings, n_files = lint_paths(paths,
                                    include_fixtures=args.include_fixtures,
                                    trace=not args.no_trace,
                                    trace_registry=args.trace,
+                                   cost_registry=args.cost,
+                                   cost_report_out=reports,
                                    select=select)
+    # in --report mode stdout carries ONLY the JSON table (CI pipes it
+    # to an artifact file); findings move to stderr
+    report_mode = args.report and args.cost
+    out = sys.stderr if report_mode else sys.stdout
     for f in findings:
-        print(f.render())
+        print(f.render(), file=out)
+    if report_mode:
+        from apex_tpu.lint.traced import cost
+        print(cost.render_table(reports))
     tail = f"{n_files} file(s) checked"
     if findings:
         print(f"apxlint: {len(findings)} finding(s), {tail}",
               file=sys.stderr)
         return 1
-    print(f"apxlint: clean, {tail}")
+    print(f"apxlint: clean, {tail}", file=out)
     return 0
 
 

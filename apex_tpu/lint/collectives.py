@@ -364,6 +364,13 @@ def _function_paths(fn, env, defs, depth) -> _PathSet:
 # -- module entry ------------------------------------------------------------
 
 def check_module(tree: ast.Module, path: str) -> List[Finding]:
+    # prescan: every APX201 event and APX202 axis argument originates
+    # at a call whose name is in _AXIS_USERS (local callees included —
+    # they live in this same module). A module with none can produce
+    # no finding, so skip the exponential path enumeration outright.
+    if not any(isinstance(n, ast.Call) and call_name(n) in _AXIS_USERS
+               for n in ast.walk(tree)):
+        return []
     findings: List[Finding] = []
     aliases = _module_aliases(tree)
     valid = _valid_axes() | _local_axes(tree)

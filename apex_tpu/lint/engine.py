@@ -9,10 +9,14 @@ lintable when passed as explicit paths.
 
 Checks come in two shapes:
 
-- per-file AST checks (``kernels``, ``collectives``, ``hygiene``) get
+- per-file AST checks (``kernels``, ``collectives``) get
   ``(tree, path)`` and return findings;
 - project checks run once over the whole file set: ``amp_lists`` (needs
-  the op-list module and every call site together) and ``vmem`` (the
+  the op-list module and every call site together), ``hygiene`` (roots
+  jitted callables across module boundaries, so
+  ``jax.jit(imported_fn)`` in one file taints the defining file),
+  ``meta`` (APX105 tier-coverage of pallas_call families — needs only
+  the registries' module lists, no jax import), and ``vmem`` (the
   trace-time budget evaluation of the registered kernel configs,
   skipped with ``trace=False``);
 - the trace tier (``trace_registry=True`` / CLI ``--trace``) walks the
@@ -20,7 +24,11 @@ Checks come in two shapes:
   runs the APX5xx jaxpr-level verifiers. Its findings land on the
   traced module's file at line 1 and pass through the same suppression
   machinery (use ``# apxlint: disable-file=CODE`` — trace findings have
-  no meaningful source line).
+  no meaningful source line);
+- the cost tier (``cost_registry=True`` / CLI ``--cost``) shares the
+  trace tier's single ``jax.make_jaxpr`` pass, computes a per-entry
+  :class:`~apex_tpu.lint.traced.cost.CostReport`, and gates it against
+  ``budgets.json`` (APX601-604, same line-1 attribution).
 """
 
 import ast
@@ -118,6 +126,8 @@ def _read(path: str) -> Optional[str]:
 
 def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
                trace: bool = True, trace_registry: bool = False,
+               cost_registry: bool = False,
+               cost_report_out: Optional[list] = None,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
     """Run all checks over ``paths``; returns (findings, files_checked)."""
@@ -141,11 +151,14 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
             continue
         sources[path] = src
         trees[path] = tree
-        for checker in (kernels, collectives, hygiene):
+        for checker in (kernels, collectives):
             findings.extend(checker.check_module(tree, path))
 
+    findings.extend(hygiene.check_files(trees))
     findings.extend(amp_lists.check_files(trees))
-    if trace or trace_registry:
+    from apex_tpu.lint import meta
+    findings.extend(meta.check_files(trees))
+    if trace or trace_registry or cost_registry:
         # must precede first backend touch: the sharded entries (vmem's
         # bottleneck config, the trace tier's mesh entries) need the
         # 8-device CPU world
@@ -154,9 +167,17 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
     if trace:
         from apex_tpu.lint import vmem
         findings.extend(vmem.check_repo())
-    if trace_registry:
+    if trace_registry or cost_registry:
         from apex_tpu.lint import traced
-        findings.extend(traced.check_repo())
+
+        reports = cost_report_out if cost_report_out is not None else []
+        findings.extend(traced.run_entries(
+            traced.repo_entries(), run_checks=trace_registry,
+            cost_out=reports if cost_registry else None))
+        if cost_registry:
+            from apex_tpu.lint.traced import budgets
+            findings.extend(budgets.check(reports,
+                                          budgets.load_manifest()))
 
     findings = _apply_suppressions(findings, sources)
     if select is not None:

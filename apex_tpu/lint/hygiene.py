@@ -22,10 +22,19 @@ module-local function names.
 Host-module references (``time``, ``random``, ``numpy``/``np.random``,
 ``datetime``) are matched against the module's actual imports, so
 ``from jax import random`` never false-positives.
+
+Roots also propagate *across modules*: ``jax.jit(sample_tokens)`` in
+``serving/scheduler.py`` makes ``sample_tokens`` — defined in
+``serving/sampling.py`` — a traced body, even though sampling.py itself
+never mentions jit. :func:`check_files` collects such imported-name
+roots per file (via the importing module's ``from apex_tpu.x import
+name`` statements), maps each dotted module back to its file in the
+linted set, and seeds them into that file's reachability frontier.
 """
 
 import ast
-from typing import Dict, List, Set
+import os
+from typing import Dict, Iterable, List, Set, Tuple
 
 from apex_tpu.lint import Finding
 from apex_tpu.lint.astutil import attr_chain, call_name
@@ -116,13 +125,82 @@ def _calls(fn: ast.FunctionDef) -> Set[str]:
     return out
 
 
-def check_module(tree: ast.Module, path: str) -> List[Finding]:
+def _import_map(tree: ast.Module) -> Dict[str, Tuple[str, str]]:
+    """Local alias -> (dotted apex_tpu module, original name) for every
+    ``from apex_tpu.x import name [as alias]`` in this module."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and node.module.split(".")[0] == "apex_tpu"
+                and not node.level):
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = (node.module, a.name)
+    return out
+
+
+def _external_roots(tree: ast.Module) -> Set[Tuple[str, str]]:
+    """(dotted module, function name) pairs this module passes into a
+    tracing transform — roots it creates in OTHER files."""
+    imports = _import_map(tree)
+    if not imports:
+        return set()
+    out: Set[Tuple[str, str]] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        is_defvjp = (isinstance(node.func, ast.Attribute)
+                     and node.func.attr in ("defvjp", "defjvp"))
+        if name not in _TRANSFORMS and not is_defvjp:
+            continue
+        args = list(node.args)
+        for a in list(args):
+            if isinstance(a, ast.Call) and call_name(a) == "partial":
+                args.extend(a.args)
+        for a in args:
+            if isinstance(a, ast.Name) and a.id in imports:
+                out.add(imports[a.id])
+    return out
+
+
+def _resolve_module(dotted: str, trees: Dict[str, ast.Module]
+                    ) -> str:
+    rel = dotted.replace(".", os.sep)
+    suffixes = (os.sep + rel + ".py",
+                os.sep + rel + os.sep + "__init__.py")
+    for path in trees:
+        if path.endswith(suffixes):
+            return path
+    return ""
+
+
+def check_files(trees: Dict[str, ast.Module]) -> List[Finding]:
+    """Project pass: per-module hygiene with cross-module root
+    propagation (the only way a ``jax.jit(imported_fn)`` call site can
+    taint the defining module)."""
+    extra: Dict[str, Set[str]] = {}
+    for tree in trees.values():
+        for dotted, fname in _external_roots(tree):
+            target = _resolve_module(dotted, trees)
+            if target:
+                extra.setdefault(target, set()).add(fname)
+    findings: List[Finding] = []
+    for path in sorted(trees):
+        findings.extend(check_module(
+            trees[path], path, extra_roots=sorted(extra.get(path, ()))))
+    return findings
+
+
+def check_module(tree: ast.Module, path: str,
+                 extra_roots: Iterable[str] = ()) -> List[Finding]:
     table = _function_table(tree)
     host = _host_modules(tree)
     if not table:
         return []
     reachable = set()
     frontier = list(_roots(tree, table))
+    frontier.extend(n for n in extra_roots if n in table)
     while frontier:
         name = frontier.pop()
         if name in reachable or name not in table:

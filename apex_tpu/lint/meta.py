@@ -1,0 +1,89 @@
+"""APX105 — trace-tier coverage meta-lint.
+
+The trace-time tiers only verify what is *registered*: APX102 evaluates
+the ``apex_tpu.lint.vmem`` Config list, the APX5xx/APX6xx tiers walk
+the ``apex_tpu.lint.traced`` TraceEntry registry. A brand-new pallas
+kernel family that registers in neither is invisible to all of them —
+its VMEM residency, accumulator dtypes, and byte budgets are simply
+unchecked, with no finding to say so. This check closes that hole:
+every file under ``apex_tpu/`` that actually *calls*
+``pl.pallas_call`` must be named (as a dotted ``module``) by at least
+one VMEM Config AND at least one TraceEntry.
+
+Scoping: only files with an ``apex_tpu`` path component are examined
+(test fixtures opt in by living under a ``.../apex_tpu/`` fixture
+directory), and only ``ast.Call`` nodes count — modules that merely
+mention ``pallas_call`` in strings, attribute references, or the vmem
+monkeypatch itself are not kernel families. Coverage is resolved by
+path-suffix matching the registries' dotted module names, so no
+imports of the covered modules happen here.
+"""
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.astutil import call_name
+
+
+def _module_suffixes(dotted: str):
+    rel = dotted.replace(".", os.sep)
+    return (os.sep + rel + ".py", os.sep + rel + os.sep + "__init__.py")
+
+
+def _covered(path: str, modules: Iterable[str]) -> bool:
+    return any(path.endswith(_module_suffixes(m)) for m in modules)
+
+
+def _first_pallas_call(tree: ast.Module) -> Optional[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) == "pallas_call":
+            return node
+    return None
+
+
+def check_files(trees: Dict[str, ast.Module], *,
+                vmem_modules: Optional[Iterable[str]] = None,
+                trace_modules: Optional[Iterable[str]] = None
+                ) -> List[Finding]:
+    """APX105 findings over the linted file set.
+
+    ``vmem_modules`` / ``trace_modules`` are injectable for tests; by
+    default they come from the two live registries (pure-python
+    imports — the Config/TraceEntry builders stay lazy).
+    """
+    marker = os.sep + "apex_tpu" + os.sep
+    interesting = {}
+    for path, tree in trees.items():
+        if marker not in path:
+            continue
+        node = _first_pallas_call(tree)
+        if node is not None:
+            interesting[path] = node
+    if not interesting:
+        return []
+
+    if vmem_modules is None:
+        from apex_tpu.lint import vmem
+        vmem_modules = {c.module for c in vmem.repo_configs()}
+    if trace_modules is None:
+        from apex_tpu.lint.traced.registry import repo_entries
+        trace_modules = {e.module for e in repo_entries()}
+
+    findings: List[Finding] = []
+    for path, node in sorted(interesting.items()):
+        missing = []
+        if not _covered(path, vmem_modules):
+            missing.append("APX102 VMEM Config (apex_tpu/lint/vmem.py)")
+        if not _covered(path, trace_modules):
+            missing.append(
+                "TraceEntry (apex_tpu/lint/traced/registry.py)")
+        if missing:
+            findings.append(Finding(
+                "APX105", path, node.lineno,
+                "pallas_call kernel family is missing a registered "
+                + " and a ".join(missing)
+                + " — unregistered kernels dodge the VMEM, APX5xx, and "
+                  "cost tiers entirely"))
+    return findings

@@ -9,10 +9,15 @@ accelerator — and each traced jaxpr is handed to the verifiers:
                    APX502 loss-scale unscale/overflow-check placement;
 - ``memory``     — APX503 broadcast/materialization blowup;
 - ``schedule``   — APX511 per-rank SPMD collective-schedule simulation;
-- ``aliases``    — APX512 declared ``input_output_aliases`` survival.
+- ``aliases``    — APX512 declared ``input_output_aliases`` survival;
+- ``cost``       — APX6xx abstract HBM-traffic / collective-volume /
+                   peak-live interpreter, gated by ``budgets`` against
+                   the committed ``budgets.json`` manifest.
 
-Run via ``python -m apex_tpu.lint --trace``. Import side effects are
-kept minimal: jax is only imported when a check actually runs.
+Run via ``python -m apex_tpu.lint --trace`` (APX5xx) and/or ``--cost``
+(APX6xx; both tiers share one ``jax.make_jaxpr`` pass per entry).
+Import side effects are kept minimal: jax is only imported when a
+check actually runs.
 """
 
 from apex_tpu.lint.traced.registry import (  # noqa: F401

@@ -1,0 +1,206 @@
+"""APX601-604 — per-entrypoint byte budgets over the cost tier.
+
+``budgets.json`` (committed next to this module) is the reviewed
+contract: for every registered trace entry it pins the expected HBM
+traffic, the collective volume, and the peak-live estimate, plus two
+*hand-ownable* knobs — an ``hbm_ceiling`` and a ``peak_live_cap``
+(seeded at 1.25x measured by ``--write-budgets``, preserved verbatim
+on regeneration so a reviewer-tightened ceiling survives).
+
+Findings:
+
+- **APX601** — an entry's total HBM bytes exceed its ceiling: a real
+  traffic regression (e.g. a dropped ``donate_argnums`` doubling the
+  KV-cache bytes).
+- **APX602** — an entry drifted outside the +-tolerance band around
+  the recorded ``hbm_bytes`` without a manifest update (or the entry /
+  manifest is missing, or the manifest lists an entry that no longer
+  exists). This is the "say so in the diff" check: a PR that changes
+  traffic must regenerate budgets.json so the byte delta is reviewable.
+- **APX603** — collective volume differs from the manifest (exact:
+  communication schedules are deterministic, so any change is a
+  schedule change).
+- **APX604** — peak-live estimate exceeds the per-entry cap.
+
+Update workflow (also in docs/source/static_analysis.rst): run
+``python -m apex_tpu.lint --write-budgets``, eyeball the JSON diff,
+and commit it with the PR that moved the numbers.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from apex_tpu.lint import Finding
+
+DEFAULT_TOLERANCE = 0.10
+_HEADROOM = 1.25
+
+_REQUIRED_ENTRY_KEYS = (
+    "hbm_bytes", "hbm_ceiling", "collective_bytes",
+    "peak_live_bytes", "peak_live_cap",
+)
+
+
+def manifest_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "budgets.json")
+
+
+def validate(manifest) -> List[str]:
+    """Schema errors as strings; empty means well-formed."""
+    errs: List[str] = []
+    if not isinstance(manifest, dict):
+        return ["manifest is not a JSON object"]
+    if manifest.get("version") != 1:
+        errs.append("missing or unsupported 'version' (expected 1)")
+    tol = manifest.get("tolerance")
+    if not isinstance(tol, (int, float)) or not 0 < tol < 1:
+        errs.append("'tolerance' must be a fraction in (0, 1)")
+    entries = manifest.get("entries")
+    if not isinstance(entries, dict):
+        errs.append("'entries' must be an object keyed by entry name")
+        return errs
+    for name, row in sorted(entries.items()):
+        if not isinstance(row, dict):
+            errs.append(f"entry '{name}' is not an object")
+            continue
+        for key in _REQUIRED_ENTRY_KEYS:
+            v = row.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(
+                    f"entry '{name}' key '{key}' must be a"
+                    " non-negative integer")
+    return errs
+
+
+def load_manifest(path: Optional[str] = None) -> Optional[dict]:
+    """The committed manifest, or None when it doesn't exist yet."""
+    path = path or manifest_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _from_report(report) -> Dict[str, int]:
+    return {
+        "hbm_bytes": int(report.hbm_total_bytes),
+        "collective_bytes": int(report.collective_bytes),
+        "peak_live_bytes": int(report.peak_live_bytes),
+    }
+
+
+def build_manifest(reports, previous: Optional[dict] = None,
+                   tolerance: Optional[float] = None) -> dict:
+    """Manifest dict from fresh reports. Hand-ownable knobs (ceilings,
+    caps, tolerance) carry over from ``previous``; new entries get
+    1.25x-measured headroom."""
+    prev_entries = (previous or {}).get("entries", {})
+    if tolerance is None:
+        tolerance = (previous or {}).get("tolerance", DEFAULT_TOLERANCE)
+    entries: Dict[str, dict] = {}
+    for rep in reports:
+        row = _from_report(rep)
+        old = prev_entries.get(rep.entry, {})
+        row["hbm_ceiling"] = int(old.get(
+            "hbm_ceiling", row["hbm_bytes"] * _HEADROOM))
+        row["peak_live_cap"] = int(old.get(
+            "peak_live_cap", row["peak_live_bytes"] * _HEADROOM))
+        entries[rep.entry] = {k: row[k] for k in _REQUIRED_ENTRY_KEYS}
+    return {"version": 1, "tolerance": tolerance, "entries": entries}
+
+
+def write_manifest(reports, path: Optional[str] = None,
+                   previous: Optional[dict] = "__load__") -> dict:
+    path = path or manifest_path()
+    if previous == "__load__":
+        previous = load_manifest(path)
+    manifest = build_manifest(reports, previous=previous)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return manifest
+
+
+def _gb(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f} MiB"
+    return f"{n} B"
+
+
+def check(reports, manifest: Optional[dict],
+          path: Optional[str] = None) -> List[Finding]:
+    """APX601-604 findings for fresh reports vs the committed manifest.
+
+    Entry-level findings land on the entry's module path (line 1) so
+    file-level suppressions apply; manifest-level problems (missing
+    file, schema, stale entries) land on budgets.json itself.
+    """
+    path = path or manifest_path()
+    findings: List[Finding] = []
+    if manifest is None:
+        findings.append(Finding(
+            "APX602", path, 1,
+            "budgets.json does not exist — seed it with"
+            " `python -m apex_tpu.lint --write-budgets`"))
+        return findings
+    errs = validate(manifest)
+    if errs:
+        findings.append(Finding(
+            "APX602", path, 1,
+            "budgets.json fails schema validation: " + "; ".join(errs)))
+        return findings
+
+    tol = float(manifest["tolerance"])
+    entries: Dict[str, dict] = manifest["entries"]
+    seen = set()
+    for rep in reports:
+        seen.add(rep.entry)
+        row = entries.get(rep.entry)
+        if row is None:
+            findings.append(Finding(
+                "APX602", rep.module, 1,
+                f"trace entry '{rep.entry}' has no budget in"
+                " budgets.json — regenerate with"
+                " `python -m apex_tpu.lint --write-budgets`"))
+            continue
+        total = rep.hbm_total_bytes
+        if total > row["hbm_ceiling"]:
+            findings.append(Finding(
+                "APX601", rep.module, 1,
+                f"entry '{rep.entry}' HBM traffic {_gb(total)} exceeds"
+                f" its budget ceiling {_gb(row['hbm_ceiling'])} — a"
+                " memory-traffic regression (check donation/aliasing"
+                " before raising the ceiling)"))
+        expected = row["hbm_bytes"]
+        if abs(total - expected) > tol * max(expected, 1):
+            findings.append(Finding(
+                "APX602", rep.module, 1,
+                f"entry '{rep.entry}' HBM traffic {_gb(total)} drifted"
+                f" outside the +-{tol:.0%} band around the recorded"
+                f" {_gb(expected)} — if intentional, regenerate"
+                " budgets.json in this PR so the delta is reviewed"))
+        if rep.collective_bytes != row["collective_bytes"]:
+            findings.append(Finding(
+                "APX603", rep.module, 1,
+                f"entry '{rep.entry}' collective volume"
+                f" {_gb(rep.collective_bytes)} != recorded"
+                f" {_gb(row['collective_bytes'])} — the communication"
+                " schedule changed; regenerate budgets.json if"
+                " intentional"))
+        if rep.peak_live_bytes > row["peak_live_cap"]:
+            findings.append(Finding(
+                "APX604", rep.module, 1,
+                f"entry '{rep.entry}' peak-live estimate"
+                f" {_gb(rep.peak_live_bytes)} exceeds its cap"
+                f" {_gb(row['peak_live_cap'])}"))
+    for name in sorted(set(entries) - seen):
+        findings.append(Finding(
+            "APX602", path, 1,
+            f"budgets.json lists entry '{name}' which is no longer"
+            " registered — regenerate with"
+            " `python -m apex_tpu.lint --write-budgets`"))
+    return findings

@@ -103,8 +103,16 @@ def _module_path(dotted: str) -> str:
         return dotted
 
 
-def run_entries(entries: List[TraceEntry]) -> List[Finding]:
-    """Trace every entry and run its checks; APX100 on trace failure."""
+def run_entries(entries: List[TraceEntry], *, run_checks: bool = True,
+                cost_out: Optional[list] = None) -> List[Finding]:
+    """Trace every entry and run its checks; APX100 on trace failure.
+
+    Each entry is traced exactly once. With ``run_checks`` the APX5xx
+    verifiers run over the jaxpr; with ``cost_out`` a
+    :class:`~apex_tpu.lint.traced.cost.CostReport` per entry is
+    appended to that list (APX100 if cost analysis itself fails) — the
+    ``--trace --cost`` CLI combination shares the single trace.
+    """
     ensure_cpu_devices()
     import jax
 
@@ -135,6 +143,19 @@ def run_entries(entries: List[TraceEntry]) -> List[Finding]:
                 f"{type(exc).__name__}: {exc}"))
             continue
 
+        if cost_out is not None:
+            from apex_tpu.lint.traced import cost
+
+            try:
+                cost_out.append(cost.compute(closed, path, e.name))
+            except Exception as exc:  # noqa: BLE001 - surfaced
+                findings.append(Finding(
+                    "APX100", path, 1,
+                    f"trace entry '{e.name}' cost analysis failed: "
+                    f"{type(exc).__name__}: {exc}"))
+
+        if not run_checks:
+            continue
         if "precision" in e.checks:
             findings.extend(precision.check_reductions(closed, path, e.name))
         if "amp" in e.checks:
@@ -566,6 +587,108 @@ def _decode_step_entry(tp=None):
     return build
 
 
+def _prefill_step_bucketed_entry():
+    """The ContinuousBatchingScheduler prefill path: a prompt padded up
+    to the 32-token bucket rung, 4-slot pool (scheduler.pad_to_bucket
+    + DecodeEngine per-bucket jitted step)."""
+    def build():
+        from apex_tpu.serving.decode import make_prefill_fn
+
+        cfg = _serving_cfg()
+        params, cache = _serving_args(cfg, num_slots=4, max_len=64)
+        fn = make_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 32), "int32"),
+                    _sds((32,), "int32"), _sds((), "int32"))
+
+    return build
+
+
+def _decode_step_learned_pos_entry():
+    """Decode without RoPE — the learned-position-table gather variant
+    of _block_decode (gpt_tiny defaults to use_rope=False)."""
+    def build():
+        from apex_tpu.models.gpt import gpt_tiny
+        from apex_tpu.serving.decode import make_decode_fn
+
+        cfg = gpt_tiny()
+        params, cache = _serving_args(cfg)
+        fn = make_decode_fn(cfg)
+        return fn, (params, cache, _sds((2,), "int32"), _sds((2,), "bool"))
+
+    return build
+
+
+def _decode_step_medium_entry():
+    """The BASELINE.md r8 roofline shape: gpt_medium-class decode, bf16
+    params, 32 slots parked at depth 512 (the steady-state mid-cache
+    occupancy the hand derivation prices). Cost-tier only — APX5xx
+    already runs on the tiny-shape decode entries."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig, init_gpt
+        from apex_tpu.serving.cache import init_cache
+        from apex_tpu.serving.decode import make_decode_fn
+
+        cfg = GPTConfig(use_rope=True)
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(init_cache, cfg, 32, 512))
+        fn = make_decode_fn(cfg)
+        return fn, (params, cache, _sds((32,), "int32"),
+                    _sds((32,), "bool"))
+
+    return build
+
+
+def _fused_softmax_entry():
+    """Both fused-softmax pallas families (masked 4D + causal 3D),
+    fwd+bwd through the custom_vjp."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.transformer.functional import fused_softmax as fs
+
+        def loss(x, mask, x3):
+            y = fs.scaled_masked_softmax(x, mask, scale=0.5)
+            z = fs.scaled_upper_triang_masked_softmax(x3, scale=0.5)
+            return (jnp.sum(y.astype(jnp.float32) ** 2)
+                    + jnp.sum(z.astype(jnp.float32) ** 2))
+
+        fn = lambda *a: jax.value_and_grad(loss, (0, 2))(*a)
+        return fn, (_sds((2, 2, 128, 128), "bfloat16"),
+                    _sds((2, 1, 128, 128), "int32"),
+                    _sds((4, 128, 128), "bfloat16"))
+
+    return build
+
+
+def _flat_simple_entry(which):
+    """The three non-optimizer flat kernels (scale / axpby / l2norm):
+    pure streaming, no input_output_aliases, so no aliases check."""
+    rows = 8192
+
+    def build():
+        import functools as ft
+
+        from apex_tpu.multi_tensor_apply import kernels as K
+
+        buf = _sds((rows, 128), "float32")
+        if which == "scale":
+            return ft.partial(K.flat_scale, scale=0.5,
+                              interpret=True), (buf,)
+        if which == "axpby":
+            return (lambda x, y: K.flat_axpby(1.0, x, 2.0, y,
+                                              interpret=True)), (buf, buf)
+        return ft.partial(K.flat_l2norm, interpret=True), (buf,)
+
+    return build
+
+
 def _mesh(pp=1, vpp=None, tp=1, cp=1, n_devices=None):
     def setup():
         import jax
@@ -660,6 +783,25 @@ def repo_entries() -> List[TraceEntry]:
                    _decode_step_entry(tp=2),
                    checks=("precision", "memory", "schedule", "aliases"),
                    mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=3),
+        TraceEntry("gpt_prefill_step_bucketed", "apex_tpu.serving.decode",
+                   _prefill_step_bucketed_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        TraceEntry("gpt_decode_step_learned_pos", "apex_tpu.serving.decode",
+                   _decode_step_learned_pos_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        # cost-tier anchor for the BASELINE r8/r9 decode roofline; no
+        # APX5xx checks (the tiny-shape decode entries above carry them
+        # — this one exists so budgets.json pins the headline bytes)
+        TraceEntry("gpt_decode_step_medium", "apex_tpu.serving.decode",
+                   _decode_step_medium_entry(), checks=()),
+        TraceEntry("fused_softmax_fwd_bwd",
+                   "apex_tpu.transformer.functional.fused_softmax",
+                   _fused_softmax_entry()),
+        TraceEntry("flat_scale", flat, _flat_simple_entry("scale")),
+        TraceEntry("flat_axpby", flat, _flat_simple_entry("axpby")),
+        TraceEntry("flat_l2norm", flat, _flat_simple_entry("l2norm")),
     ]
     return entries
 

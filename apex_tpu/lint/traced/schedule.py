@@ -16,9 +16,12 @@ integer/boolean values that derive from ``axis_index`` and literals
 through arithmetic/comparison primitives; everything else is Unknown.
 The walk emits an ordered *footprint* of nested tuples:
 
-- ``("coll", prim, axes, extra)`` for each collective —
+- ``("coll", prim, axes, extra, nbytes)`` for each collective —
   ``ppermute`` includes its full permutation, ``all_to_all``/
-  ``all_gather`` their axis params;
+  ``all_gather`` their axis params; ``nbytes`` is the per-rank operand
+  byte count (aval-derived, so rank-independent — the APX6xx cost tier
+  prices communication volume from it without changing the equality
+  semantics here);
 - ``("scan", length, body_footprint)`` / ``("while", cond_fp,
   body_fp)`` for loops (collectives inside a loop rendezvous once per
   iteration, so the loop structure is part of the schedule);
@@ -112,7 +115,9 @@ def _footprint(jaxpr_like, env, rank) -> Tuple:
             if name == "ppermute":
                 perm = tuple(tuple(p) for p in eqn.params.get("perm", ()))
                 extra = (perm,)
-            out.append(("coll", name, axes, extra))
+            nbytes = sum(jl.aval_bytes(v.aval) for v in eqn.invars
+                         if not jl.is_literal(v))
+            out.append(("coll", name, axes, extra, nbytes))
             continue
 
         if name == "scan":

@@ -288,6 +288,44 @@ def _flat_cfg(which):
     return build
 
 
+def _xentropy_cfg():
+    def build():
+        import jax
+
+        from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+        def loss(logits, labels):
+            return softmax_cross_entropy_loss(logits, labels).mean()
+
+        fn = lambda lg, lb: jax.value_and_grad(loss)(lg, lb)
+        return fn, (_sds((1024, 512), "float32"), _sds((1024,), "int32"))
+
+    return build
+
+
+def _fused_softmax_cfg():
+    """Both fused-softmax families (masked 4D + causal 3D) fwd+bwd at
+    full 128-row tiles."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.transformer.functional import fused_softmax as fs
+
+        def loss(x, mask, x3):
+            y = fs.scaled_masked_softmax(x, mask, scale=0.5)
+            z = fs.scaled_upper_triang_masked_softmax(x3, scale=0.5)
+            return (jnp.sum(y.astype(jnp.float32))
+                    + jnp.sum(z.astype(jnp.float32)))
+
+        fn = lambda *a: jax.value_and_grad(loss, (0, 2))(*a)
+        return fn, (_sds((2, 2, 128, 128), "bfloat16"),
+                    _sds((2, 1, 128, 128), "int32"),
+                    _sds((4, 128, 128), "bfloat16"))
+
+    return build
+
+
 def _bottleneck_cfg():
     """Halo'd 3x3-conv spatial bottleneck, H sharded over ``context``.
 
@@ -344,6 +382,11 @@ def repo_configs() -> List[Config]:
     for which in ("adam", "sgd", "lamb", "adagrad", "novograd", "scale",
                   "axpby", "l2norm"):
         cfgs.append(Config(f"flat_{which}", flat, _flat_cfg(which)))
+    cfgs.append(Config("xentropy_fwd_bwd", "apex_tpu.contrib.xentropy",
+                       _xentropy_cfg()))
+    cfgs.append(Config("fused_softmax_fwd_bwd",
+                       "apex_tpu.transformer.functional.fused_softmax",
+                       _fused_softmax_cfg()))
     cfgs.append(Config("bottleneck_spatial_cp2",
                        "apex_tpu.contrib.bottleneck.bottleneck",
                        _bottleneck_cfg()))

@@ -518,7 +518,7 @@ def bench_tp_gpt(on_tpu):
 # -- serving: batched KV-cached decode --------------------------------------
 
 def _decode_bench_setup(on_tpu, cache_dtype, slots=None):
-    """(body, make_init, fetch, slots, s_max): one greedy decode step
+    """(body, make_init, fetch, slots, s_max, cfg): one greedy decode step
     over the serving KV cache for every slot — the steady-state
     continuous-batching inner loop, no host scheduler in the timed
     region. Lengths park mid-cache and reset before reaching the end so
@@ -567,11 +567,32 @@ def _decode_bench_setup(on_tpu, cache_dtype, slots=None):
 
     fetch = lambda s: (jnp.sum(s[1].lengths)  # noqa: E731
                        + jnp.sum(s[2])).astype(jnp.float32)
-    return body, make_init, fetch, slots, s_max
+    return body, make_init, fetch, slots, s_max, cfg
+
+
+def _decode_model_bytes(cfg, slots, depth, param_dtype, cache_dtype):
+    """HBM bytes per generated token from the APX6xx abstract cost
+    interpreter, over the same decode program at the parked cache
+    depth. Pure trace — no compile, no device work — so it prices the
+    roofline the measured tokens/sec should be compared against."""
+    from apex_tpu.lint.traced import cost
+    from apex_tpu.models.gpt import init_gpt
+    from apex_tpu.serving.cache import init_cache
+    from apex_tpu.serving.decode import make_decode_fn
+
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg, param_dtype), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(
+        functools.partial(init_cache, cfg, slots, depth, cache_dtype))
+    closed = jax.make_jaxpr(make_decode_fn(cfg))(
+        params, cache, jax.ShapeDtypeStruct((slots,), jnp.int32),
+        jax.ShapeDtypeStruct((slots,), jnp.bool_))
+    rep = cost.compute(closed, __file__, "gpt_decode")
+    return int(rep.hbm_total_bytes // slots)
 
 
 def bench_gpt_decode(on_tpu):
-    body, make_init, fetch, slots, s_max = _decode_bench_setup(
+    body, make_init, fetch, slots, s_max, cfg = _decode_bench_setup(
         on_tpu, jnp.bfloat16)
     dt = timed(body, make_init, fetch, M=20 if on_tpu else 2,
                donate=True)
@@ -590,6 +611,12 @@ def bench_gpt_decode(on_tpu):
     extra.update({"slots": slots, "seq_max": s_max,
                   "cache_dtype": "bfloat16",
                   "per_token_latency_ms": round(dt * 1e3, 3)})
+    try:
+        extra["model_bytes_per_token"] = _decode_model_bytes(
+            cfg, slots, s_max // 2,
+            jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16)
+    except Exception as e:  # static cross-check must never sink the bench
+        extra["model_bytes_per_token_error"] = repr(e)
     emit(metric, slots / dt, "tokens/sec", extra=extra)
 
 
@@ -599,7 +626,7 @@ def _decode_cache_ab_pair(on_tpu):
     Smaller slot count than the driver metric: the non-donating A/B
     harness holds both sides' caches (and two copies each) live."""
     def side(dtype):
-        body, make_init, fetch, _, _ = _decode_bench_setup(
+        body, make_init, fetch, _, _, _ = _decode_bench_setup(
             on_tpu, dtype, slots=8 if on_tpu else 2)
         return _ab_side(body, make_init(), fetch, M=10 if on_tpu else 2)
 

@@ -12,14 +12,15 @@
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
 #                           #     fp32 golden curve — run on every PR
-#   ./run_tests.sh lint     # apxlint, both tiers: AST contract checks
-#                           #     (kernel aliasing, collectives, AMP
-#                           #     lists, hygiene), the VMEM budget pass,
-#                           #     and the jaxpr trace tier (APX5xx) over
-#                           #     the entry registry — blocking in CI,
-#                           #     with a 60s combined wall-time budget
-#                           #     enforced so the gate stays fast enough
-#                           #     to run on every push
+#   ./run_tests.sh lint     # apxlint, all three tiers: AST contract
+#                           #     checks (kernel aliasing, collectives,
+#                           #     AMP lists, hygiene), the VMEM budget
+#                           #     pass, the jaxpr trace tier (APX5xx)
+#                           #     over the entry registry, and the cost
+#                           #     tier (APX6xx byte budgets) — blocking
+#                           #     in CI, with a combined wall-time
+#                           #     budget enforced so the gate stays
+#                           #     fast enough to run on every push
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -34,14 +35,17 @@ case "$tier" in
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
-  lint)  # combined AST + VMEM + trace tiers, under a wall-time budget:
-         # a slow lint gate stops being run, so exceeding the budget is
-         # itself a failure (trim the entry registry or speed it up)
+  lint)  # combined AST + VMEM + trace + cost tiers, under a wall-time
+         # budget: a slow lint gate stops being run, so exceeding the
+         # budget is itself a failure (trim the entry registry or speed
+         # it up)
+         budget=90
          start=$SECONDS
-         python -m apex_tpu.lint apex_tpu tests --trace "$@"
+         python -m apex_tpu.lint apex_tpu tests --trace --cost "$@"
          elapsed=$(( SECONDS - start ))
-         if (( elapsed > 60 )); then
-           echo "apxlint: combined run took ${elapsed}s, budget is 60s" >&2
+         if (( elapsed > budget )); then
+           echo "apxlint: combined run took ${elapsed}s," \
+                "budget is ${budget}s" >&2
            exit 1
          fi ;;
   *)     echo "usage: $0 [L0|L1|all|quick|gate|lint] [pytest args...]" >&2

@@ -1,0 +1,18 @@
+# apxlint: fixture
+# Known-bad: a real pallas_call kernel family living under an apex_tpu/
+# path component that no VMEM Config and no TraceEntry names — APX105
+# must fire exactly once, on the pallas_call line.
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def double(x):
+    spec = pl.BlockSpec(x.shape, lambda: (0, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _double_kernel, in_specs=[spec], out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)

@@ -1,0 +1,10 @@
+# apxlint: fixture
+# Known-clean twin: mentions pallas_call in a docstring, a string, and
+# a bare attribute reference, but never *calls* it — no kernel family
+# here, so APX105 must stay silent even though the file sits under an
+# apex_tpu/ path component.
+"""Helper that merely documents how pl.pallas_call kernels register."""
+from jax.experimental import pallas as pl
+
+KERNEL_ENTRY = pl.pallas_call  # referenced, not called
+NOTE = "wrap with pallas_call(kernel, ...) then add vmem + trace rows"

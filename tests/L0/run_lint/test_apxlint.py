@@ -22,12 +22,13 @@ def _codes(*names, **kw):
 
 def test_codes_registry_complete():
     assert set(CODES) == {
-        "APX100", "APX101", "APX102", "APX103",
+        "APX100", "APX101", "APX102", "APX103", "APX105",
         "APX201", "APX202",
         "APX301", "APX302", "APX303", "APX304",
         "APX401", "APX402",
         "APX501", "APX502", "APX503",
         "APX511", "APX512",
+        "APX601", "APX602", "APX603", "APX604",
     }
     assert all(CODES[c] for c in CODES)  # every code documented
 
@@ -63,6 +64,34 @@ def test_apx401_host_state_read():
 
 def test_apx402_global_write():
     assert _codes("apx402_bad.py") == ["APX402"]
+
+
+def test_apx105_unregistered_kernel_family():
+    bad = os.path.join("apx105", "apex_tpu", "apx105_bad.py")
+    clean = os.path.join("apx105", "apex_tpu", "apx105_clean.py")
+    codes = _codes(bad)
+    assert codes == ["APX105"], codes
+    assert _codes(clean) == []
+
+
+def test_apx105_registration_resolved_by_path_suffix():
+    import ast as ast_mod
+
+    from apex_tpu.lint import meta
+
+    p = os.path.join(FIXTURES, "apx105", "apex_tpu", "apx105_bad.py")
+    with open(p) as f:
+        trees = {p: ast_mod.parse(f.read())}
+    dotted = "apx105.apex_tpu.apx105_bad"
+    # named by both registries: covered
+    assert meta.check_files(trees, vmem_modules=[dotted],
+                            trace_modules=[dotted]) == []
+    # named by only one: the finding spells out which half is missing
+    only_vmem = meta.check_files(trees, vmem_modules=[dotted],
+                                 trace_modules=[])
+    assert [f.code for f in only_vmem] == ["APX105"]
+    assert "TraceEntry" in only_vmem[0].message
+    assert "APX102" not in only_vmem[0].message
 
 
 def test_suppression_comments():
