@@ -103,12 +103,23 @@ class DistributedFusedAdam:
             m=jnp.zeros(master.shape, self.m_dtype),
             v=jnp.zeros_like(master))
 
-    def partition_spec(self) -> DistributedAdamState:
+    def partition_spec(self, *, tensor_axis: Optional[str] = None
+                       ) -> DistributedAdamState:
         """PartitionSpecs for the state pytree (shard_map in_specs /
-        ``NamedSharding`` at rest): master/m/v row-sharded over data."""
+        ``NamedSharding`` at rest): master/m/v row-sharded over data.
+
+        Under dp x tp the flat buffers are built from TP-LOCAL param
+        shards, so each tp rank holds different rows: pass
+        ``tensor_axis`` to shard the row dim over ``(tensor_axis, data)``
+        jointly — tuple order is major-to-minor, so rank ``(t, d)`` owns
+        block ``t*dp + d``, matching the per-(t,d) ``psum_scatter`` over
+        ``data`` inside :meth:`step`."""
         from jax.sharding import PartitionSpec as P
 
-        row = P(self.axis_name, None)
+        if tensor_axis is None:
+            row = P(self.axis_name, None)
+        else:
+            row = P((tensor_axis, self.axis_name), None)
         return DistributedAdamState(step=P(), master=row, m=row, v=row)
 
     def step(self, grads: Any, params: Any, state: DistributedAdamState,

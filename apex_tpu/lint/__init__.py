@@ -74,6 +74,22 @@ CODES = {
               "budgets.json record (communication schedule changed)",
     "APX604": "entry's peak-live-bytes estimate exceeds its "
               "budgets.json cap",
+    "APX701": "partition-rule table defect: a registered tree leaf is "
+              "matched by zero or multiple rules, a spec outranks its "
+              "array / names an unknown or repeated mesh axis, or a "
+              "rule matches nothing (dead rule)",
+    "APX702": "cross-tree sharding drift: optimizer moments / master "
+              "weights carry a different spec than their param, the "
+              "KV-cache head axis disagrees with the qkv weights' tp "
+              "axis, or rule-derived specs diverge from the "
+              "hand-maintained reference",
+    "APX703": "rule-derived shard_map in_specs disagree with the "
+              "partition table under the staged mesh, or a matmul "
+              "operand above the byte floor enters the body fully "
+              "replicated (silent GSPMD fallback)",
+    "APX704": "rule-generated shard_map body fails per-rank schedule "
+              "agreement (APX511 simulator) or its collective volume "
+              "diverges from the budgets.json record",
 }
 
 

@@ -8,11 +8,19 @@ jax import; ``--trace`` additionally runs the jaxpr-level trace tier
 registry; ``--cost`` runs the APX6xx cost tier (static HBM-traffic /
 collective-volume budgets vs ``budgets.json`` — combine with
 ``--report`` to dump the per-entry table as JSON on stdout with
-findings on stderr, or ``--write-budgets`` to regenerate the
-manifest); ``--select`` narrows to a comma-separated code list.
+findings on stderr, or ``--write-budgets`` to regenerate the manifest,
+``--write-budgets --prune`` to also drop manifest entries whose
+registry entry no longer exists); ``--sharding`` runs the APX7xx
+sharding tier (partition-rule tables plus the rule-staged shard_map
+programs) over the ``apex_tpu.lint.sharded`` entry registry;
+``--select`` narrows the *output* to a comma-separated code list;
+``--codes APX511,APX70*`` instead names the checks to *run* — globs
+expand against the catalogue and the owning tiers are enabled
+automatically.
 """
 
 import argparse
+import fnmatch
 import sys
 
 from apex_tpu.lint import CODES
@@ -36,16 +44,33 @@ def main(argv=None) -> int:
                     help="also run the APX6xx cost tier: per-entry "
                          "static HBM/collective byte budgets vs "
                          "budgets.json")
+    ap.add_argument("--sharding", action="store_true",
+                    help="also run the APX7xx sharding tier: "
+                         "partition-rule table coverage/consistency "
+                         "and rule-staged shard_map verification")
     ap.add_argument("--report", action="store_true",
                     help="with --cost: print the per-entry cost table "
                          "as JSON to stdout (findings go to stderr)")
     ap.add_argument("--write-budgets", action="store_true",
                     help="retrace the registry and regenerate "
                          "budgets.json (hand-tightened ceilings/caps "
-                         "are preserved), then exit")
+                         "are preserved; stale entries are kept unless "
+                         "--prune), then exit")
+    ap.add_argument("--prune", action="store_true",
+                    help="with --write-budgets: drop budgets.json "
+                         "entries whose registry entry no longer "
+                         "exists (each pruned name is printed)")
     ap.add_argument("--select", default=None, metavar="CODES",
                     help="comma-separated codes to report "
                          "(e.g. APX101,APX201)")
+    ap.add_argument("--codes", default=None, metavar="GLOBS",
+                    help="run a named subset of checks across tiers: "
+                         "comma-separated codes or globs expanded "
+                         "against the catalogue (e.g. APX511,APX70*); "
+                         "the tiers owning the matched codes (--trace "
+                         "for APX5xx, --cost for APX6xx, --sharding "
+                         "for APX7xx) are enabled automatically and "
+                         "only the matched codes are reported")
     ap.add_argument("--include-fixtures", action="store_true",
                     help="also lint files marked '# apxlint: fixture'")
     ap.add_argument("--list-codes", action="store_true",
@@ -56,6 +81,11 @@ def main(argv=None) -> int:
         for code, doc in sorted(CODES.items()):
             print(f"{code}  {doc}")
         return 0
+
+    if args.prune and not args.write_budgets:
+        print("--prune only makes sense with --write-budgets",
+              file=sys.stderr)
+        return 2
 
     if args.write_budgets:
         from apex_tpu.lint.traced import budgets, registry
@@ -69,7 +99,12 @@ def main(argv=None) -> int:
             print(f.render(), file=sys.stderr)
         if findings:  # refuse to pin budgets from a broken trace
             return 1
-        manifest = budgets.write_manifest(reports)
+        previous = budgets.load_manifest()
+        if args.prune:
+            for name in budgets.pruned_names(reports, previous):
+                print(f"apxlint: pruned stale budget entry '{name}'")
+        manifest = budgets.write_manifest(reports, previous=previous,
+                                          prune=args.prune)
         print(f"apxlint: wrote {budgets.manifest_path()} "
               f"({len(manifest['entries'])} entries)")
         return 0
@@ -84,6 +119,27 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    if args.codes:
+        chosen = set()
+        for pat in (p.strip().upper() for p in args.codes.split(",")):
+            if not pat:
+                continue
+            hits = fnmatch.filter(CODES, pat)
+            if not hits:
+                print(f"--codes pattern {pat!r} matches no known code "
+                      f"(see --list-codes)", file=sys.stderr)
+                return 2
+            chosen.update(hits)
+        # enable the tiers that own the requested codes; pure-AST codes
+        # run in every mode, --select filters the output either way
+        if any(c.startswith("APX5") for c in chosen):
+            args.trace = True
+        if any(c.startswith("APX6") for c in chosen):
+            args.cost = True
+        if any(c.startswith("APX7") for c in chosen):
+            args.sharding = True
+        select = chosen if select is None else (select & chosen)
+
     paths = args.paths or ["apex_tpu"]
     reports: list = []
     findings, n_files = lint_paths(paths,
@@ -91,6 +147,7 @@ def main(argv=None) -> int:
                                    trace=not args.no_trace,
                                    trace_registry=args.trace,
                                    cost_registry=args.cost,
+                                   sharding_registry=args.sharding,
                                    cost_report_out=reports,
                                    select=select)
     # in --report mode stdout carries ONLY the JSON table (CI pipes it

@@ -28,7 +28,11 @@ Checks come in two shapes:
 - the cost tier (``cost_registry=True`` / CLI ``--cost``) shares the
   trace tier's single ``jax.make_jaxpr`` pass, computes a per-entry
   :class:`~apex_tpu.lint.traced.cost.CostReport`, and gates it against
-  ``budgets.json`` (APX601-604, same line-1 attribution).
+  ``budgets.json`` (APX601-604, same line-1 attribution);
+- the sharding tier (``sharding_registry=True`` / CLI ``--sharding``)
+  walks the ``apex_tpu.lint.sharded`` entry registry: partition-rule
+  table coverage, cross-tree spec consistency, and rule-staged
+  shard_map verification (APX701-704, same line-1 attribution).
 """
 
 import ast
@@ -127,6 +131,7 @@ def _read(path: str) -> Optional[str]:
 def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
                trace: bool = True, trace_registry: bool = False,
                cost_registry: bool = False,
+               sharding_registry: bool = False,
                cost_report_out: Optional[list] = None,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
@@ -158,7 +163,7 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
     findings.extend(amp_lists.check_files(trees))
     from apex_tpu.lint import meta
     findings.extend(meta.check_files(trees))
-    if trace or trace_registry or cost_registry:
+    if trace or trace_registry or cost_registry or sharding_registry:
         # must precede first backend touch: the sharded entries (vmem's
         # bottleneck config, the trace tier's mesh entries) need the
         # 8-device CPU world
@@ -178,6 +183,10 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
             from apex_tpu.lint.traced import budgets
             findings.extend(budgets.check(reports,
                                           budgets.load_manifest()))
+    if sharding_registry:
+        from apex_tpu.lint import sharded
+
+        findings.extend(sharded.run_entries(sharded.repo_entries()))
 
     findings = _apply_suppressions(findings, sources)
     if select is not None:

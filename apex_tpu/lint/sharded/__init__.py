@@ -1,0 +1,20 @@
+"""apxlint sharding tier (APX701-704) — ``--sharding``.
+
+Static verification of the partition-rule engine
+(:mod:`apex_tpu.partition`): rule-table coverage and spec sanity
+(APX701), cross-tree per-tensor-family consistency — optimizer
+moments, master weights, serving KV cache, hand-maintained references
+(APX702), rule-derived ``shard_map`` in_specs surviving into the
+staged dp x tp train step with no silently-replicated matmul operands
+(APX703), and per-rank schedule agreement plus budgets.json-gated
+collective volume for the generated bodies (APX704).
+"""
+
+from apex_tpu.lint.sharded.registry import (
+    ShardedEntry,
+    check_repo,
+    repo_entries,
+    run_entries,
+)
+
+__all__ = ["ShardedEntry", "check_repo", "repo_entries", "run_entries"]

@@ -1,0 +1,206 @@
+"""Sharding-tier entry registry and driver (APX701-704).
+
+A :class:`ShardedEntry` names one partition-rule table plus everything
+the repo derives from it: the abstract trees it must cover (params,
+optimizer families, the serving KV cache), the hand-maintained
+reference spec trees it must reproduce, and — for train-step entries —
+a builder staging the rule-derived ``shard_map`` program whose
+``in_names`` and per-rank collective schedule are verified against the
+table. The table is data; these entries are what make a wrong table a
+lint finding instead of a silent mis-sharding on a pod slice.
+
+Check dispatch per entry:
+
+- ``rules`` + ``trees``            -> APX701 (coverage / spec sanity /
+  dead rules, :mod:`rules_check`)
+- ``optimizer_families`` /
+  ``reference_specs`` / ``kv_*``   -> APX702 (cross-tree consistency)
+- ``build``                        -> APX703 (in_names vs table,
+  replicated-matmul floor, :mod:`propagation`) and APX704 (per-rank
+  schedule + collective volume vs budgets.json,
+  :mod:`schedule_check`)
+
+The driver mirrors the trace tier's contract: entries trace under
+``jax.make_jaxpr`` only (abstract, CPU-safe), the global parallel state
+is snapshotted/restored around each entry, and an entry that fails to
+evaluate is an APX100 finding, never a silent skip.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.traced.registry import (
+    _mesh,
+    _module_path,
+    _restore_parallel_state,
+    _sds,
+    _snapshot_parallel_state,
+    ensure_cpu_devices,
+    zero_dp2xtp2_parts,
+)
+
+_REPLICATION_FLOOR = 1 << 20
+
+
+@dataclass
+class ShardedEntry:
+    name: str
+    module: str  # dotted module whose sharding contract this verifies
+    rules: Callable[[], tuple]
+    # name -> abstract tree (ShapeDtypeStructs); every rule must match
+    # at least one leaf across the union of these trees
+    trees: Optional[Callable[[], Dict[str, Any]]] = None
+    # name -> hand-maintained spec tree the derived specs must equal
+    reference_specs: Optional[Callable[[], Dict[str, Any]]] = None
+    # optimizer-state families re-derived under a path prefix (APX702)
+    optimizer_families: Tuple[str, ...] = ()
+    # KV-cache consistency: tree name of the cache + regex of the
+    # attention qkv kernel leaf whose output-dim axes the cache's head
+    # axis must equal
+    kv_cache_tree: Optional[str] = None
+    qkv_kernel_re: str = r"qkv/kernel"
+    # train-step staging: () -> (fn, args, in_specs)
+    build: Optional[Callable[[], Tuple[Callable, tuple, Any]]] = None
+    mesh: Optional[Callable[[], None]] = None
+    min_devices: int = 1
+    replication_floor: int = _REPLICATION_FLOOR
+    budget_name: Optional[str] = None
+
+
+def run_entries(entries: List[ShardedEntry], *,
+                manifest: Any = "__load__") -> List[Finding]:
+    """All sharding-tier findings; APX100 on any entry that fails to
+    evaluate. ``manifest`` is the budgets.json dict (or the default
+    sentinel to load the committed one) for APX704's volume gate."""
+    ensure_cpu_devices()
+    import jax
+
+    from apex_tpu.lint.sharded import propagation, rules_check, schedule_check
+    from apex_tpu.lint.traced import budgets
+
+    if manifest == "__load__":
+        manifest = budgets.load_manifest()
+
+    findings: List[Finding] = []
+    for e in entries:
+        path = _module_path(e.module)
+        try:
+            findings.extend(rules_check.check(e, path))
+        except Exception as exc:  # noqa: BLE001 - surfaced as a finding
+            findings.append(Finding(
+                "APX100", path, 1,
+                f"sharded entry '{e.name}' rule checks failed to "
+                f"evaluate: {type(exc).__name__}: {exc}"))
+        if e.build is None:
+            continue
+        snap = _snapshot_parallel_state()
+        try:
+            try:
+                have = jax.device_count()
+                if have < e.min_devices:
+                    raise RuntimeError(
+                        f"needs {e.min_devices} devices, have {have} "
+                        f"(backend initialized before ensure_cpu_devices)")
+                if e.mesh is not None:
+                    e.mesh()
+                fn, args, in_specs = e.build()
+                closed = jax.make_jaxpr(fn)(*args)
+            finally:
+                _restore_parallel_state(snap)
+        except Exception as exc:  # noqa: BLE001 - surfaced as a finding
+            findings.append(Finding(
+                "APX100", path, 1,
+                f"sharded entry '{e.name}' failed to trace: "
+                f"{type(exc).__name__}: {exc}"))
+            continue
+        findings.extend(propagation.check(closed, in_specs, path, e))
+        findings.extend(schedule_check.check(closed, path, e, manifest))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# registered rule tables / sharded entrypoints
+# ---------------------------------------------------------------------------
+
+def _gpt_trees():
+    import functools as ft
+
+    import jax
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving.cache import init_cache
+
+    cfg = gpt_tiny()
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(ft.partial(init_cache, cfg, 2, 32))
+    return {"params": params, "kv_cache": cache}
+
+
+def _gpt_reference():
+    from apex_tpu.models.gpt import gpt_partition_specs, gpt_tiny
+    from apex_tpu.partition import kv_cache_rules
+    from apex_tpu.serving.cache import cache_partition_specs
+
+    return {"params": gpt_partition_specs(gpt_tiny()),
+            "kv_cache": cache_partition_specs(kv_cache_rules())}
+
+
+def _bert_trees():
+    import jax
+
+    from apex_tpu.models.bert import bert_tiny, init_bert
+
+    params = jax.eval_shape(
+        lambda k: init_bert(k, bert_tiny()), jax.random.PRNGKey(0))
+    return {"params": params}
+
+
+def _bert_reference():
+    import jax
+
+    from apex_tpu.models.bert import (
+        bert_partition_specs, bert_tiny, init_bert,
+    )
+
+    params = jax.eval_shape(
+        lambda k: init_bert(k, bert_tiny()), jax.random.PRNGKey(0))
+    return {"params": bert_partition_specs(params)}
+
+
+def repo_entries() -> List[ShardedEntry]:
+    from apex_tpu.partition import bert_rules, gpt_rules
+
+    return [
+        ShardedEntry(
+            "gpt_tiny_rules", "apex_tpu.partition.tables",
+            rules=gpt_rules, trees=_gpt_trees,
+            reference_specs=_gpt_reference,
+            optimizer_families=("m", "v", "master"),
+            kv_cache_tree="kv_cache",
+            qkv_kernel_re=r"layers/qkv/kernel"),
+        ShardedEntry(
+            "bert_tiny_rules", "apex_tpu.partition.tables",
+            rules=bert_rules, trees=_bert_trees,
+            reference_specs=_bert_reference,
+            optimizer_families=("m", "v", "master")),
+        # trace-staged: same builder as the gpt_tiny_dp2xtp2_zero
+        # TraceEntry, so APX703/704 see exactly the program the APX5xx
+        # and APX6xx tiers gate
+        ShardedEntry(
+            "gpt_tiny_dp2xtp2_zero",
+            "apex_tpu.contrib.optimizers.distributed_fused_adam",
+            rules=gpt_rules,
+            build=zero_dp2xtp2_parts,
+            mesh=_mesh(tp=2, n_devices=4), min_devices=4,
+            budget_name="gpt_tiny_dp2xtp2_zero"),
+    ]
+
+
+def check_repo() -> List[Finding]:
+    return run_entries(repo_entries())
+
+
+__all__ = ["ShardedEntry", "repo_entries", "run_entries", "check_repo",
+           "_sds"]

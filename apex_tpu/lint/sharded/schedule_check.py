@@ -1,0 +1,68 @@
+"""APX704 — per-rank schedule + collective volume of rule-staged steps.
+
+The sharded tier's last line of defense reuses the two interpreters the
+earlier tiers already trust:
+
+- the APX511 per-rank schedule simulator
+  (:mod:`apex_tpu.lint.traced.schedule`) walks the rule-generated
+  ``shard_map`` body once per rank of the staged mesh — dp-axis psums
+  and tp-axis reduce-scatters must agree rank-pairwise, or the table
+  generated a program that deadlocks a real slice. Those findings are
+  re-issued under APX704 (the defect is in the *generated* program, so
+  suppression and CI gating stay per-tier);
+- the APX6xx collective-volume interpreter
+  (:mod:`apex_tpu.lint.traced.cost`) prices the staged program's
+  communication, which must equal the ``budgets.json`` record named by
+  ``budget_name`` byte-for-byte — a rule-table change that moves
+  collective volume is reviewable only through a budgets.json diff.
+"""
+
+from typing import Any, List, Optional
+
+from apex_tpu.lint import Finding
+
+
+def check(closed, path: str, entry,
+          manifest: Optional[dict] = None) -> List[Finding]:
+    from apex_tpu.lint.traced import cost, schedule
+
+    findings: List[Finding] = []
+    for f in schedule.check(closed, path, entry.name):
+        findings.append(Finding(
+            "APX704", f.path, f.line,
+            f"rule-generated schedule: {f.message}"))
+
+    if entry.budget_name is None:
+        return findings
+    try:
+        report = cost.compute(closed, path, entry.name)
+    except Exception as exc:  # noqa: BLE001 - surfaced as a finding
+        findings.append(Finding(
+            "APX100", path, 1,
+            f"sharded entry '{entry.name}' collective pricing failed: "
+            f"{type(exc).__name__}: {exc}"))
+        return findings
+    row = _budget_row(manifest, entry.budget_name)
+    if row is None:
+        findings.append(Finding(
+            "APX704", path, 1,
+            f"entry '{entry.name}': no budgets.json record "
+            f"'{entry.budget_name}' to gate its collective volume — "
+            f"seed it with `python -m apex_tpu.lint --write-budgets`"))
+    elif report.collective_bytes != row.get("collective_bytes"):
+        findings.append(Finding(
+            "APX704", path, 1,
+            f"entry '{entry.name}': staged collective volume "
+            f"{report.collective_bytes} B != budgets.json record "
+            f"{row.get('collective_bytes')} B for "
+            f"'{entry.budget_name}' — the rule table changed the "
+            f"communication schedule; regenerate budgets.json if "
+            f"intentional"))
+    return findings
+
+
+def _budget_row(manifest: Optional[dict], name: str) -> Optional[Any]:
+    if not isinstance(manifest, dict):
+        return None
+    row = manifest.get("entries", {}).get(name)
+    return row if isinstance(row, dict) else None

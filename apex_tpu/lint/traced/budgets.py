@@ -92,10 +92,18 @@ def _from_report(report) -> Dict[str, int]:
 
 
 def build_manifest(reports, previous: Optional[dict] = None,
-                   tolerance: Optional[float] = None) -> dict:
+                   tolerance: Optional[float] = None,
+                   prune: bool = False) -> dict:
     """Manifest dict from fresh reports. Hand-ownable knobs (ceilings,
     caps, tolerance) carry over from ``previous``; new entries get
-    1.25x-measured headroom."""
+    1.25x-measured headroom.
+
+    ``previous`` entries with no fresh report are carried over verbatim
+    (a partial retrace must not silently drop reviewed budgets); pass
+    ``prune=True`` to drop them instead — the fix for a renamed or
+    deleted TraceEntry whose stale row otherwise keeps an APX602
+    finding alive. :func:`pruned_names` reports what ``prune`` removes.
+    """
     prev_entries = (previous or {}).get("entries", {})
     if tolerance is None:
         tolerance = (previous or {}).get("tolerance", DEFAULT_TOLERANCE)
@@ -108,15 +116,26 @@ def build_manifest(reports, previous: Optional[dict] = None,
         row["peak_live_cap"] = int(old.get(
             "peak_live_cap", row["peak_live_bytes"] * _HEADROOM))
         entries[rep.entry] = {k: row[k] for k in _REQUIRED_ENTRY_KEYS}
+    if not prune:
+        for name, row in prev_entries.items():
+            entries.setdefault(name, row)
     return {"version": 1, "tolerance": tolerance, "entries": entries}
 
 
+def pruned_names(reports, previous: Optional[dict]) -> List[str]:
+    """Manifest entries that ``prune=True`` would drop: present in
+    ``previous`` but with no fresh report."""
+    prev = (previous or {}).get("entries", {})
+    return sorted(set(prev) - {rep.entry for rep in reports})
+
+
 def write_manifest(reports, path: Optional[str] = None,
-                   previous: Optional[dict] = "__load__") -> dict:
+                   previous: Optional[dict] = "__load__",
+                   prune: bool = False) -> dict:
     path = path or manifest_path()
     if previous == "__load__":
         previous = load_manifest(path)
-    manifest = build_manifest(reports, previous=previous)
+    manifest = build_manifest(reports, previous=previous, prune=prune)
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(manifest, fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -689,6 +689,95 @@ def _flat_simple_entry(which):
     return build
 
 
+def _local_shapes(tree, specs, axis_sizes):
+    """TP-local ShapeDtypeStructs: divide each dim of each leaf by the
+    product of the mesh-axis sizes its spec entry names (the shard a
+    rank sees inside shard_map)."""
+    import jax
+
+    def one(leaf, spec):
+        shape = list(leaf.shape)
+        for dim, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                shape[dim] //= axis_sizes.get(ax, 1)
+        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
+
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(one, tree, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def zero_dp2xtp2_parts():
+    """The ROADMAP item-3 headline config: rule-table-sharded GPT train
+    step, dp2 x tp2, ZeRO optimizer state (bf16 m) row-sharded over
+    ``(model, data)`` jointly. Returns ``(fn, args, in_specs)`` — the
+    spec tree is consumed by the APX7xx sharded tier (APX703 checks the
+    shard_map in_names against it), the ``(fn, args)`` pair by the
+    plain trace/cost tiers. Everything sharded here derives from
+    ``partition.gpt_rules()``; nothing is hand-specified."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        DistributedAdamState, DistributedFusedAdam,
+    )
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny, init_gpt
+    from apex_tpu.partition import gpt_rules, match_partition_rules
+    from apex_tpu.transformer import parallel_state as ps
+
+    tp, dp = 2, 2
+    cfg = gpt_tiny()
+    model = GPTModel(cfg, tp_size=tp)
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+    specs = match_partition_rules(gpt_rules(), params)
+    local_params = _local_shapes(params, specs, {ps.TENSOR_AXIS: tp})
+
+    opt = DistributedFusedAdam(lr=1e-4, weight_decay=0.01, dp_size=dp,
+                               m_dtype=jnp.bfloat16)
+    # Flat ZeRO buffers are built from the TP-LOCAL param shard (each tp
+    # rank optimizes only its own rows); at rest the global buffer
+    # stacks the tp segments, hence leading rows tp * R_local and the
+    # joint (model, data) row sharding from partition_spec().
+    local_state = jax.eval_shape(opt.init, local_params)
+    r_local = local_state.master.shape[0]
+    state = DistributedAdamState(
+        step=_sds((), local_state.step.dtype),
+        master=_sds((tp * r_local, 128), local_state.master.dtype),
+        m=_sds((tp * r_local, 128), local_state.m.dtype),
+        v=_sds((tp * r_local, 128), local_state.v.dtype))
+    zero_spec = opt.partition_spec(tensor_axis=ps.TENSOR_AXIS)
+
+    def train_step(p, st, ids, labels):
+        # local grads (check_vma=False): TP grads are already correct
+        # per-shard, dp reduction happens in the optimizer's
+        # psum_scatter; no separate DDP allreduce.
+        loss, grads = jax.value_and_grad(model.loss)(p, ids, labels)
+        new_p, new_st = opt.step(grads, p, st)
+        return lax.pmean(loss, ps.DATA_AXIS), new_p, new_st
+
+    in_specs = (specs, zero_spec, P(ps.DATA_AXIS), P(ps.DATA_AXIS))
+    fn = ps.shard_map(train_step, in_specs=in_specs,
+                      out_specs=(P(), specs, zero_spec))
+    args = (params, state, _sds((2 * dp, 32), "int32"),
+            _sds((2 * dp, 32), "int32"))
+    return fn, args, in_specs
+
+
+def _zero_dp2xtp2_entry():
+    def build():
+        fn, args, _ = zero_dp2xtp2_parts()
+        return fn, args
+
+    return build
+
+
 def _mesh(pp=1, vpp=None, tp=1, cp=1, n_devices=None):
     def setup():
         import jax
@@ -762,6 +851,14 @@ def repo_entries() -> List[TraceEntry]:
                    mesh=_mesh(pp=2, vpp=2, n_devices=2), min_devices=2),
         TraceEntry("pp_no_pipelining_fp32_accum", sched,
                    _pp_sequential_entry()),
+        # ROADMAP item 3 headline: dp2 x tp2 ZeRO train step, every
+        # sharding derived from partition.gpt_rules(); the APX7xx tier
+        # re-traces the same builder for its in_specs/schedule checks
+        TraceEntry("gpt_tiny_dp2xtp2_zero",
+                   "apex_tpu.contrib.optimizers.distributed_fused_adam",
+                   _zero_dp2xtp2_entry(),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(tp=2, n_devices=4), min_devices=4),
         TraceEntry("bottleneck_spatial_cp2",
                    "apex_tpu.contrib.bottleneck.bottleneck",
                    _bottleneck_entry(),

@@ -69,6 +69,20 @@ class FusedAdam:
         return AdamState(step=step, m=tree_zeros(params, self.m_dtype),
                          v=tree_zeros(params, jnp.float32))
 
+    def state_partition_specs(self, param_specs: Any) -> AdamState:
+        """PartitionSpecs for the (tree-layout) state, given the params'
+        spec tree: moments shard exactly like their params, the step
+        counter replicates. The APX702 sharding check verifies the
+        partition-rule tables reproduce this tensor-by-tensor. Not valid
+        with ``use_flat_kernel`` (the flat buffer has its own layout)."""
+        if self.use_flat_kernel:
+            raise ValueError(
+                "state_partition_specs describes the tree layout; the flat "
+                "kernel's packed buffer is sharded by its caller")
+        from jax.sharding import PartitionSpec as P
+
+        return AdamState(step=P(), m=param_specs, v=param_specs)
+
     def step(self, grads: Any, params: Any, state: AdamState, *,
              lr=None, grad_scale=1.0, weight_decay=None,
              found_inf: Optional[jax.Array] = None,

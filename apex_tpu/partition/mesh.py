@@ -1,0 +1,49 @@
+"""dp x tp x pp x cp mesh factory over ``parallel_state``.
+
+The SNIPPETS.md [2] ``get_mesh(num_nodes, gpus_per_node, mp_size,
+dp_size)`` idiom, restated in this repo's vocabulary: callers name the
+parallelism degrees they want and the factory builds/installs the
+global mesh through :func:`parallel_state.initialize_model_parallel`
+(which owns the canonical axis names and the dp-innermost /
+model-outermost device order) — it never constructs a second,
+subtly-different ``Mesh`` of its own. The explicit ``dp`` argument is
+forwarded as the initializer's validation hook, so asking for
+``make_mesh(dp=4, tp=2)`` on an 8-device world fails loudly instead of
+silently landing on a different data-parallel degree.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def make_mesh(dp: int = 1, tp: int = 1, pp: int = 1, cp: int = 1, *,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build and install the global ``(data, pipe, context, model)``
+    mesh for the requested degrees, using the first ``dp*tp*pp*cp``
+    devices (all devices must be consumed exactly when ``devices`` is
+    passed explicitly). Returns the installed mesh."""
+    for name, n in (("dp", dp), ("tp", tp), ("pp", pp), ("cp", cp)):
+        if int(n) < 1:
+            raise ValueError(f"{name} must be a positive integer, got {n}")
+    need = int(dp) * int(tp) * int(pp) * int(cp)
+    if devices is None:
+        devices = jax.devices()
+        if need > len(devices):
+            raise ValueError(
+                f"mesh dp{dp} x tp{tp} x pp{pp} x cp{cp} needs {need} "
+                f"devices, have {len(devices)}")
+        devices = devices[:need]
+    elif len(devices) != need:
+        raise ValueError(
+            f"mesh dp{dp} x tp{tp} x pp{pp} x cp{cp} needs exactly "
+            f"{need} devices, got {len(devices)}")
+    return ps.initialize_model_parallel(
+        tensor_model_parallel_size_=int(tp),
+        pipeline_model_parallel_size_=int(pp),
+        context_parallel_size_=int(cp),
+        data_parallel_size_=int(dp),
+        devices=devices)

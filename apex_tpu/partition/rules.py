@@ -1,0 +1,156 @@
+"""Regex-rule -> PartitionSpec-pytree engine.
+
+ROADMAP item 3's fix for bespoke per-subsystem sharding wiring: ONE
+ordered rule table — ``(pattern, PartitionSpec)`` pairs matched with
+``re.search`` against each leaf's ``/``-joined tree path — produces the
+spec pytree for any parameter-shaped tree. Model params, optimizer
+moments/master weights, and the serving KV cache all derive their specs
+from the same table (see :mod:`apex_tpu.partition.tables`), which is
+what makes the APX7xx lint tier's cross-tree consistency checks
+(``apex_tpu/lint/sharded/``) possible: the table is the single source
+of truth the checker verifies every derived tree against.
+
+Conventions (the JAX LM-community idiom, e.g. EasyLM/levanter-style
+``match_partition_rules``):
+
+- matching is ``re.search``, so unanchored patterns apply at any tree
+  depth (``layers/qkv/kernel`` matches the stacked GPT layer leaves and
+  the same leaves under an ``m/``- or ``v/``-prefixed optimizer tree);
+- rank-0 (scalar) leaves are replicated (``P()``) without consulting
+  the table — step counters and loss scalars never need rules;
+- the FIRST matching rule wins, but the default tables are written
+  overlap-free and APX701 flags any leaf matched by more than one rule;
+- a leaf no rule matches raises ``ValueError`` — silent full
+  replication of an unmatched tensor is exactly the bug class this
+  engine exists to kill.
+"""
+
+import re
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+Rule = Tuple[str, PartitionSpec]
+
+
+def tree_path_name(path) -> str:
+    """``/``-joined name of one ``tree_flatten_with_path`` key path
+    (dict keys, namedtuple fields, and sequence indices all render as
+    their plain string form)."""
+    parts = []
+    for k in path:
+        part = getattr(k, "key", None)
+        if part is None:
+            part = getattr(k, "name", None)
+        if part is None:
+            part = getattr(k, "idx", None)
+        parts.append(str(k) if part is None else str(part))
+    return "/".join(parts)
+
+
+def tree_paths(tree: Any) -> List[str]:
+    """The ``/``-joined path of every leaf, in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [tree_path_name(path) for path, _ in flat]
+
+
+def _is_scalar(leaf) -> bool:
+    return len(getattr(leaf, "shape", ())) == 0
+
+
+def match_partition_rules(rules: Sequence[Rule], params: Any) -> Any:
+    """Spec pytree for ``params``: first ``re.search`` match per leaf
+    path wins; scalar leaves are replicated; an unmatched non-scalar
+    leaf raises ``ValueError``."""
+    def assign(path, leaf):
+        name = tree_path_name(path)
+        if _is_scalar(leaf):
+            return PartitionSpec()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(
+            f"no partition rule matches leaf '{name}' "
+            f"(shape {tuple(getattr(leaf, 'shape', ()))}) — every "
+            "non-scalar leaf must be covered by the rule table")
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def rule_match_table(rules: Sequence[Rule],
+                     params: Any) -> List[Tuple[str, Any, List[int]]]:
+    """Per-leaf match bookkeeping for the APX701 coverage check:
+    ``(path, leaf, [indices of every rule whose pattern matches])`` for
+    each leaf, scalars included (their index list is informational —
+    scalars replicate regardless)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        name = tree_path_name(path)
+        hits = [i for i, (pattern, _) in enumerate(rules)
+                if re.search(pattern, name)]
+        out.append((name, leaf, hits))
+    return out
+
+
+def spec_axis_names(spec: PartitionSpec) -> List[str]:
+    """Every mesh axis named in a spec, in order, flattening tuple
+    entries like ``(("model", "data"), None)``."""
+    out: List[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            out.append(str(ax))
+    return out
+
+
+def optimizer_state_specs(rules: Sequence[Rule], params: Any,
+                          families: Sequence[str] = ("m", "v", "master"),
+                          ) -> dict:
+    """Spec trees for params-shaped optimizer state, derived from the
+    SAME rule table by re-matching under a per-family path prefix
+    (``m/<param path>`` etc).
+
+    Because matching is ``re.search``, an unanchored table yields specs
+    identical to the params' — which is the contract APX702 verifies. A
+    table that anchors a pattern at the tree root (``^embedding/...``)
+    silently stops matching the prefixed moment paths, and the moments
+    fall through to a later rule or to the unmatched error: exactly the
+    per-tensor-family drift the lint tier reports instead of raising.
+    """
+    return {fam: match_partition_rules(rules, {fam: params})[fam]
+            for fam in families}
+
+
+def make_shard_and_gather_fns(partition_specs: Any, mesh=None,
+                              ) -> Tuple[Any, Any]:
+    """Pytrees of per-leaf ``shard_fn(x)`` / ``gather_fn(x)`` matching
+    ``partition_specs`` (the SNIPPETS.md [1] idiom on NamedSharding):
+    shard places a host or replicated array onto the mesh under its
+    spec; gather pulls a sharded array back to a fully-replicated host
+    value (checkpoint save path)."""
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        from apex_tpu.transformer import parallel_state as ps
+
+        mesh = ps.get_mesh()
+
+    def make_shard(spec) -> Callable:
+        sharding = NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sharding)
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def make_gather(spec) -> Callable:
+        del spec  # gather target is always the replicated layout
+        return lambda x: jax.device_get(jax.device_put(x, replicated))
+
+    is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+    shard_fns = jax.tree_util.tree_map(make_shard, partition_specs,
+                                       is_leaf=is_spec)
+    gather_fns = jax.tree_util.tree_map(make_gather, partition_specs,
+                                        is_leaf=is_spec)
+    return shard_fns, gather_fns

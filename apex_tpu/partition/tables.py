@@ -1,0 +1,86 @@
+"""Default partition-rule tables (GPT / BERT / serving KV cache).
+
+One table per model family covers everything that family shards: the
+parameter tree, the optimizer moments/master weights derived from it
+(see :func:`apex_tpu.partition.rules.optimizer_state_specs`), and — for
+GPT — the serving KV cache
+(:func:`apex_tpu.serving.cache.cache_partition_specs` matches its
+``KVCache`` template against the same table). The tables are written
+OVERLAP-FREE: every leaf matches exactly one rule, which APX701
+enforces for each registered tree, and the layouts reproduce the
+hand-maintained references (``models.gpt.gpt_partition_specs``,
+``models.bert.bert_partition_specs``) that APX702 cross-checks them
+against.
+
+Layout recap (Megatron over the ``model`` mesh axis):
+
+- vocab-sharded word embeddings ``P(model, None)``; position /
+  token-type tables replicated;
+- Column-parallel qkv/fc1: output dim sharded (kernel last dim, bias);
+- Row-parallel out/fc2: input dim sharded, bias replicated (added
+  after the psum);
+- layer norms replicated;
+- GPT layer leaves carry a leading stacked-``num_layers`` dim (the
+  ``lax.scan`` depth loop), hence the extra leading ``None``;
+- KV cache: heads (axis 2 of ``(L, slots, heads, S, d)``) shard over
+  ``model`` — each rank caches exactly the heads its head-major qkv
+  column shard produces; slot lengths are replicated.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+
+# KV-cache rules, shared by both model tables: the paths are the
+# ``KVCache`` namedtuple fields, matched at end-of-path so a model
+# param ending differently can never collide.
+_KV_CACHE_RULES = (
+    (r"(^|/)(k|v)$", P(None, None, ps.TENSOR_AXIS, None, None)),
+    (r"(^|/)lengths$", P()),
+)
+
+
+def kv_cache_rules():
+    """The serving-cache slice of the default tables."""
+    return _KV_CACHE_RULES
+
+
+def gpt_rules():
+    """Rule table for the GPT param tree (``models.gpt.init_gpt``) plus
+    the serving KV cache. First match wins; table is overlap-free."""
+    t = ps.TENSOR_AXIS
+    return (
+        ("embedding/word/embedding", P(t, None)),
+        ("embedding/position/embedding", P()),
+        ("layers/(ln1|ln2)/(weight|bias)", P(None)),
+        ("layers/qkv/kernel", P(None, None, t)),
+        ("layers/qkv/bias", P(None, t)),
+        ("layers/out/kernel", P(None, t, None)),
+        ("layers/out/bias", P(None)),
+        ("layers/fc1/kernel", P(None, None, t)),
+        ("layers/fc1/bias", P(None, t)),
+        ("layers/fc2/kernel", P(None, t, None)),
+        ("layers/fc2/bias", P(None)),
+        ("final_ln/(weight|bias)", P()),
+    ) + _KV_CACHE_RULES
+
+
+def bert_rules():
+    """Rule table for the BERT param tree (``models.bert.init_bert``).
+    BERT layers are a list (paths carry ``encoder/<i>/``), so patterns
+    stay unanchored; layer norms everywhere replicate via one rule."""
+    t = ps.TENSOR_AXIS
+    return (
+        ("embeddings/word/embedding", P(t, None)),
+        ("embeddings/(position|token_type)/embedding", P()),
+        ("layernorm/(weight|bias)", P()),
+        ("(qkv|fc1)/kernel", P(None, t)),
+        ("(qkv|fc1)/bias", P(t)),
+        ("(attention/out|fc2)/kernel", P(t, None)),
+        ("(attention/out|fc2)/bias", P()),
+        ("mlm_head/transform/(kernel|bias)", P()),
+        ("mlm_head/bias", P()),
+        ("pooler/(kernel|bias)", P()),
+        # no KV-cache rules: BERT is not served incrementally, and a
+        # rule that can never match would be an APX701 dead-rule finding
+    )

@@ -56,13 +56,25 @@ def init_cache(cfg: GPTConfig, num_slots: int, max_len: int,
                    lengths=jnp.zeros((num_slots,), jnp.int32))
 
 
-def cache_partition_specs() -> KVCache:
+def cache_partition_specs(rules=None) -> KVCache:
     """TP layout: heads (axis 2) shard over the ``model`` mesh axis —
     the cache shard each rank sees inside shard_map holds exactly the
-    heads its qkv column shard produces. Lengths are replicated."""
-    from jax.sharding import PartitionSpec as P
+    heads its qkv column shard produces. Lengths are replicated.
 
-    from apex_tpu.transformer import parallel_state as ps
+    Derived from the partition-rule table (``partition.kv_cache_rules``
+    by default, or any table covering the ``k``/``v``/``lengths``
+    paths), so serving stays consistent with whatever table shards the
+    model — APX702 checks the head axis against the qkv weights' ``tp``
+    axis."""
+    import jax
 
-    kv = P(None, None, ps.TENSOR_AXIS, None, None)
-    return KVCache(k=kv, v=kv, lengths=P())
+    from apex_tpu.partition import kv_cache_rules, match_partition_rules
+
+    if rules is None:
+        rules = kv_cache_rules()
+    # Rank-faithful abstract template: matching only reads paths/ranks.
+    template = KVCache(
+        k=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
+        v=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
+        lengths=jax.ShapeDtypeStruct((1,), "int32"))
+    return match_partition_rules(rules, template)

@@ -62,13 +62,16 @@ def initialize_model_parallel(
     pipeline_model_parallel_split_rank_: Optional[int] = None,
     *,
     context_parallel_size_: int = 1,
+    data_parallel_size_: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build and register the global mesh.
 
     Signature mirrors the reference (``parallel_state.py ::
     initialize_model_parallel``); data-parallel size is inferred as
-    ``world // (tp * pp * cp)``. Returns the mesh (also installed globally).
+    ``world // (tp * pp * cp)``. ``data_parallel_size_`` is a validation
+    hook (used by ``partition.make_mesh``): when given, the inferred dp
+    must equal it. Returns the mesh (also installed globally).
     """
     global _MESH
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
@@ -86,6 +89,11 @@ def initialize_model_parallel(
             f"world size {world} not divisible by tp*pp*cp = {tp}*{pp}*{cp}"
         )
     dp = world // denom
+    if data_parallel_size_ is not None and dp != int(data_parallel_size_):
+        raise ParallelStateError(
+            f"requested data_parallel_size {data_parallel_size_} but world "
+            f"{world} with tp*pp*cp = {tp}*{pp}*{cp} gives dp = {dp}"
+        )
     if virtual_pipeline_model_parallel_size_ is not None and pp < 2:
         raise ParallelStateError(
             "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"

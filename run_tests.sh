@@ -12,15 +12,17 @@
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
 #                           #     fp32 golden curve — run on every PR
-#   ./run_tests.sh lint     # apxlint, all three tiers: AST contract
+#   ./run_tests.sh lint     # apxlint, all four tiers: AST contract
 #                           #     checks (kernel aliasing, collectives,
 #                           #     AMP lists, hygiene), the VMEM budget
 #                           #     pass, the jaxpr trace tier (APX5xx)
-#                           #     over the entry registry, and the cost
-#                           #     tier (APX6xx byte budgets) — blocking
-#                           #     in CI, with a combined wall-time
-#                           #     budget enforced so the gate stays
-#                           #     fast enough to run on every push
+#                           #     over the entry registry, the cost
+#                           #     tier (APX6xx byte budgets), and the
+#                           #     sharding tier (APX7xx partition-rule
+#                           #     contracts) — blocking in CI, with a
+#                           #     combined wall-time budget enforced so
+#                           #     the gate stays fast enough to run on
+#                           #     every push
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -35,13 +37,14 @@ case "$tier" in
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
-  lint)  # combined AST + VMEM + trace + cost tiers, under a wall-time
-         # budget: a slow lint gate stops being run, so exceeding the
-         # budget is itself a failure (trim the entry registry or speed
-         # it up)
+  lint)  # combined AST + VMEM + trace + cost + sharding tiers, under a
+         # wall-time budget: a slow lint gate stops being run, so
+         # exceeding the budget is itself a failure (trim the entry
+         # registry or speed it up)
          budget=90
          start=$SECONDS
-         python -m apex_tpu.lint apex_tpu tests --trace --cost "$@"
+         python -m apex_tpu.lint apex_tpu tests --trace --cost \
+             --sharding "$@"
          elapsed=$(( SECONDS - start ))
          if (( elapsed > budget )); then
            echo "apxlint: combined run took ${elapsed}s," \

@@ -29,6 +29,7 @@ def test_codes_registry_complete():
         "APX501", "APX502", "APX503",
         "APX511", "APX512",
         "APX601", "APX602", "APX603", "APX604",
+        "APX701", "APX702", "APX703", "APX704",
     }
     assert all(CODES[c] for c in CODES)  # every code documented
 
