@@ -618,6 +618,94 @@ def _decode_step_learned_pos_entry():
     return build
 
 
+def _paged_serving_args(cfg, num_slots=2, max_len=32, num_pages=6,
+                        page_size=16):
+    import functools as ft
+
+    import jax
+
+    from apex_tpu.models.gpt import init_gpt
+    from apex_tpu.serving.cache import init_paged_cache
+
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(ft.partial(
+        init_paged_cache, cfg, num_slots, max_len, num_pages, page_size))
+    return params, cache
+
+
+def _paged_prefill_step_entry():
+    """Paged prefill: one 16-token bucket = one page tile scattered to
+    ``write_pages`` plus the slot's block-table row — all four cache
+    leaves (pool k/v, lengths, block tables) written in place."""
+    def build():
+        from apex_tpu.serving.decode import make_paged_prefill_fn
+
+        cfg = _serving_cfg()
+        params, cache = _paged_serving_args(cfg)
+        fn = make_paged_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 16), "int32"),
+                    _sds((16,), "int32"), _sds((), "int32"),
+                    _sds((1,), "int32"), _sds((2,), "int32"))
+
+    return build
+
+
+def _paged_decode_step_entry(tp=None):
+    """Paged decode: scatter the new row through the block table, then
+    gather each slot's pages and attend (APX105 pins this file's
+    registration for the new gather/scatter entrypoints)."""
+    def build():
+        from apex_tpu.serving.decode import (
+            make_paged_decode_fn, make_tp_paged_decode_fn,
+        )
+
+        cfg = _serving_cfg()
+        params, cache = _paged_serving_args(cfg)
+        if tp is None:
+            fn = make_paged_decode_fn(cfg)
+        else:
+            from apex_tpu.models.gpt import GPTModel
+
+            fn = make_tp_paged_decode_fn(GPTModel(cfg, tp_size=tp))
+        return fn, (params, cache, _sds((2,), "int32"), _sds((2,), "bool"))
+
+    return build
+
+
+def _paged_decode_step_medium_ragged_entry():
+    """The r10 paged counterpart of ``gpt_decode_step_medium``: same r8
+    model shape and 32 slots, but the pool is sized to a RAGGED length
+    ladder (uniform 32..512, page size 64) — Σ ceil(len/64) pages plus
+    the two reserved ones — so the cost tier's K/V read term is
+    proportional to tokens actually held instead of slots x S_max.
+    Cost-tier only, like the dense medium entry."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig, init_gpt
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.decode import make_paged_decode_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        fn = make_paged_decode_fn(cfg)
+        return fn, (params, cache, _sds((slots,), "int32"),
+                    _sds((slots,), "bool"))
+
+    return build
+
+
 def _decode_step_medium_entry():
     """The BASELINE.md r8 roofline shape: gpt_medium-class decode, bf16
     params, 32 slots parked at depth 512 (the steady-state mid-cache
@@ -888,11 +976,31 @@ def repo_entries() -> List[TraceEntry]:
                    _decode_step_learned_pos_entry(),
                    checks=("precision", "memory", "aliases"),
                    min_alias_pairs=3),
+        # paged serving: 4 donated leaves (pool k/v, lengths, block
+        # tables) — min_alias_pairs=4 pins the whole-cache donation
+        TraceEntry("gpt_paged_prefill_step", "apex_tpu.serving.decode",
+                   _paged_prefill_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=4),
+        TraceEntry("gpt_paged_decode_step", "apex_tpu.serving.decode",
+                   _paged_decode_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=4),
+        TraceEntry("gpt_paged_decode_step_tp2", "apex_tpu.serving.decode",
+                   _paged_decode_step_entry(tp=2),
+                   checks=("precision", "memory", "schedule", "aliases"),
+                   mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=4),
         # cost-tier anchor for the BASELINE r8/r9 decode roofline; no
         # APX5xx checks (the tiny-shape decode entries above carry them
         # — this one exists so budgets.json pins the headline bytes)
         TraceEntry("gpt_decode_step_medium", "apex_tpu.serving.decode",
                    _decode_step_medium_entry(), checks=()),
+        # r10: ragged-length paged pool at the same model shape — its
+        # budgets.json row demonstrates the K/V-read cut vs the dense
+        # slots x S_max charge above (BASELINE.md r10)
+        TraceEntry("gpt_paged_decode_step_medium_ragged",
+                   "apex_tpu.serving.decode",
+                   _paged_decode_step_medium_ragged_entry(), checks=()),
         TraceEntry("fused_softmax_fwd_bwd",
                    "apex_tpu.transformer.functional.fused_softmax",
                    _fused_softmax_entry()),

@@ -366,6 +366,41 @@ def _bottleneck_cfg():
     return build
 
 
+def _paged_serving_cfg(which):
+    """Paged serving steps under the recorder: prefill runs flash
+    attention over the prompt bucket (its pallas blocks are what the
+    budget prices); decode's gather/scatter is XLA math today, so — as
+    with the bottleneck config — registering it pins the trace and
+    covers any Pallas paged-attention kernel that lands later."""
+    def build():
+        import dataclasses
+        import functools as ft
+
+        import jax
+
+        from apex_tpu.models.gpt import gpt_tiny, init_gpt
+        from apex_tpu.serving.cache import init_paged_cache
+        from apex_tpu.serving.decode import (
+            make_paged_decode_fn, make_paged_prefill_fn,
+        )
+
+        cfg = dataclasses.replace(gpt_tiny(), use_rope=True)
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, 2, 32, 6, 16))
+        if which == "prefill":
+            fn = make_paged_prefill_fn(cfg)
+            return fn, (params, cache, _sds((1, 16), "int32"),
+                        _sds((16,), "int32"), _sds((), "int32"),
+                        _sds((1,), "int32"), _sds((2,), "int32"))
+        fn = make_paged_decode_fn(cfg)
+        return fn, (params, cache, _sds((2,), "int32"),
+                    _sds((2,), "bool"))
+
+    return build
+
+
 def repo_configs() -> List[Config]:
     flat = "apex_tpu.multi_tensor_apply.kernels"
     flash = "apex_tpu.transformer.functional.flash_attention"
@@ -390,6 +425,10 @@ def repo_configs() -> List[Config]:
     cfgs.append(Config("bottleneck_spatial_cp2",
                        "apex_tpu.contrib.bottleneck.bottleneck",
                        _bottleneck_cfg()))
+    cfgs.append(Config("gpt_paged_prefill_step", "apex_tpu.serving.decode",
+                       _paged_serving_cfg("prefill")))
+    cfgs.append(Config("gpt_paged_decode_step", "apex_tpu.serving.decode",
+                       _paged_serving_cfg("decode")))
     return cfgs
 
 

@@ -373,6 +373,78 @@ def _block_decode(lp, x, k_cache, v_cache, pos, cfg, rope_freqs,
     return x + mlp, k_cache, v_cache
 
 
+def _paged_decode_attention(q_k_v: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, block_tables: jax.Array,
+                            pos: jax.Array, cfg: GPTConfig,
+                            rope_freqs: Optional[jax.Array]):
+    """Single-query attention against a PAGED KV pool.
+
+    ``q_k_v`` is (b, 1, 3*h_local); ``k_pages``/``v_pages`` are
+    (num_pages, nh_local, page_size, hd) — one layer's slice of the
+    shared physical pool; ``block_tables`` (b, max_pages) int32 maps
+    each slot's logical page index to a physical page; ``pos`` (b,)
+    int32 is each slot's current length. The paged analogue of
+    :func:`_decode_attention`'s write-new-row-then-attend contract: the
+    new row is scattered into physical page ``block_tables[b, pos //
+    page_size]`` at row ``pos % page_size`` BEFORE attending, then the
+    slot's whole table row is gathered back and masked to ``s <= pos``.
+
+    Placement invariance: masked scores are set to ``finfo(f32).min``,
+    so their softmax probabilities are EXACTLY zero and garbage beyond
+    ``pos`` — stale rows, other requests' pages reached through the
+    gather, the scratch page — contributes exactly ``0 * v`` to the
+    context. Active-slot logits are therefore bit-identical for any
+    physical page assignment of the same logical contents (the serving
+    contract ``tests/L0/run_serving`` pins).
+    """
+    b = q_k_v.shape[0]
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, 1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+    logical = jnp.clip(pos // page_size, 0, block_tables.shape[1] - 1)
+    pages = jnp.take_along_axis(block_tables, logical[:, None], 1)[:, 0]
+    rows = pos % page_size
+    # (pages, :, rows) pairs advanced indices around a slice, so the
+    # scatter value is (b, nh_local, hd): the new row for every slot in
+    # one in-place update of the donated pool (APX512's contract)
+    k_pages = k_pages.at[pages, :, rows].set(
+        k[:, :, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[pages, :, rows].set(
+        v[:, :, 0].astype(v_pages.dtype))
+    # gather each slot's table row: (b, max_pages, nh, page, hd) ->
+    # (b, nh, S, hd) with S = max_pages * page_size logical positions
+    kg = k_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    vg = v_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    s_max = kg.shape[2] * kg.shape[3]
+    kg = kg.reshape(b, kg.shape[1], s_max, hd)
+    vg = vg.reshape(b, vg.shape[1], s_max, hd)
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) / math.sqrt(hd)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= pos[:, None, None, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     vg.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, 1, -1), k_pages, v_pages
+
+
+def _block_decode_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
+                        rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_decode` over the paged pool (block-table
+    indirection instead of a per-slot cache row)."""
+    att, k_pages, v_pages = _paged_decode_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, block_tables, pos, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages
+
+
 def _maybe_dropout(x, rate, rng, salt):
     if rng is None or rate <= 0:
         return x

@@ -32,11 +32,15 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.transformer import parallel_state as ps
 
 # KV-cache rules, shared by both model tables: the paths are the
-# ``KVCache`` namedtuple fields, matched at end-of-path so a model
-# param ending differently can never collide.
+# ``KVCache``/``PagedKVCache`` namedtuple fields, matched at
+# end-of-path so a model param ending differently can never collide.
+# The k/v rule covers BOTH layouts — dense ``(L, slots, heads, S, d)``
+# and paged ``(L, pages, heads, page, d)`` keep heads on axis 2; block
+# tables (paged only) replicate, every rank indexes the same mapping.
 _KV_CACHE_RULES = (
     (r"(^|/)(k|v)$", P(None, None, ps.TENSOR_AXIS, None, None)),
     (r"(^|/)lengths$", P()),
+    (r"(^|/)block_tables$", P()),
 )
 
 

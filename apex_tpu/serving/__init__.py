@@ -4,23 +4,35 @@ Reference anchor: the apex-fed Megatron stacks are served with
 KV-cached autoregressive generation (``megatron/text_generation``);
 this package is that path for ``apex_tpu.models.gpt``, TPU-first:
 
-- ``cache``     — preallocated per-layer K/V buffers + per-slot length
-  tracking, updated in place via ``lax.dynamic_update_slice`` with
-  buffer donation (apxlint APX512 pins the donation in the trace tier);
-- ``decode``    — bucketed prefill + single-token decode steps, an
-  unsharded path and a TP-sharded path (heads over the ``model`` axis);
+- ``cache``     — two cache layouts updated in place via donated
+  buffers (apxlint APX512 pins the donation in the trace tier): the
+  dense per-slot ``KVCache`` and the paged ``PagedKVCache`` (fixed page
+  pool + per-slot block tables, K/V HBM proportional to allocated
+  pages instead of ``slots x S_max``);
+- ``paging``    — host-side page allocator: free list, refcounts,
+  prefix-hash cache with LRU eviction, copy-on-write bookkeeping;
+- ``decode``    — bucketed prefill + single-token decode steps over
+  either layout, an unsharded path and a TP-sharded path (heads over
+  the ``model`` axis);
 - ``sampling``  — greedy / temperature / top-k under explicit PRNG keys;
 - ``scheduler`` — fixed-slot continuous batching (admit/evict on EOS or
-  max-len; jit recompiles only per prompt bucket, never per request).
+  max-len; jit recompiles only per prompt bucket, never per request),
+  over either engine; the paged engine adds prefix sharing at admission
+  and preemption-by-requeue when the pool runs dry.
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
-    KVCache, cache_partition_specs, init_cache,
+    KVCache, PagedKVCache, cache_partition_specs, init_cache,
+    init_paged_cache, paged_cache_partition_specs,
 )
 from apex_tpu.serving.decode import (  # noqa: F401
-    make_decode_fn, make_prefill_fn, make_tp_decode_fn, make_tp_prefill_fn,
+    make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
+    make_paged_prefill_fn, make_prefill_fn, make_tp_decode_fn,
+    make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
+    make_tp_prefill_fn,
 )
+from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
 from apex_tpu.serving.sampling import sample_tokens  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
-    ContinuousBatchingScheduler, DecodeEngine, Request,
+    ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
 )

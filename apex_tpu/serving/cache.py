@@ -66,8 +66,6 @@ def cache_partition_specs(rules=None) -> KVCache:
     paths), so serving stays consistent with whatever table shards the
     model — APX702 checks the head axis against the qkv weights' ``tp``
     axis."""
-    import jax
-
     from apex_tpu.partition import kv_cache_rules, match_partition_rules
 
     if rules is None:
@@ -77,4 +75,80 @@ def cache_partition_specs(rules=None) -> KVCache:
         k=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
         v=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
         lengths=jax.ShapeDtypeStruct((1,), "int32"))
+    return match_partition_rules(rules, template)
+
+
+# ---------------------------------------------------------------------------
+# paged cache: fixed page pool + per-slot block tables
+# ---------------------------------------------------------------------------
+
+# Physical page ids below this are reserved and never allocated:
+NULL_PAGE = 0     # parks unmapped block-table entries; never written
+SCRATCH_PAGE = 1  # write dump for redirected rows; never attended
+RESERVED_PAGES = 2
+
+
+class PagedKVCache(NamedTuple):
+    """Paged layout: ``k``/``v`` hold a POOL of fixed-size pages shared
+    by every slot — ``(L, num_pages, num_heads, page_size, head_dim)``
+    — and ``block_tables`` (``(num_slots, max_pages)`` int32) maps each
+    slot's logical page index to a physical page. HBM for K/V history
+    scales with pages actually allocated (Σ ceil(len/page_size)), not
+    ``slots x S_max``; the host-side allocator
+    (:class:`apex_tpu.serving.paging.PagePool`) owns which pages are
+    live, shared (prefix caching) or free. Heads (axis 2) still shard
+    over ``model`` under TP; lengths and block tables are replicated.
+    """
+    k: jax.Array             # (L, num_pages, num_heads, page_size, hd)
+    v: jax.Array             # (L, num_pages, num_heads, page_size, hd)
+    lengths: jax.Array       # (num_slots,) int32, valid positions
+    block_tables: jax.Array  # (num_slots, max_pages) int32 page ids
+
+
+def max_pages_per_slot(max_len: int, page_size: int) -> int:
+    return -(-max_len // page_size)
+
+
+def init_paged_cache(cfg: GPTConfig, num_slots: int, max_len: int,
+                     num_pages: int, page_size: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    """Zero page pool + block tables parked on ``SCRATCH_PAGE`` (writes
+    of unoccupied slots land in scratch, reads of it are masked)."""
+    if max_len < 1 or num_slots < 1 or page_size < 1:
+        raise ValueError(
+            f"need positive num_slots/max_len/page_size, got "
+            f"{num_slots}/{max_len}/{page_size}")
+    if num_pages <= RESERVED_PAGES:
+        raise ValueError(
+            f"num_pages {num_pages} must exceed the {RESERVED_PAGES} "
+            f"reserved pages (null + scratch)")
+    if not cfg.use_rope and max_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_len {max_len} exceeds the learned position table "
+            f"({cfg.max_position_embeddings}); raise "
+            "max_position_embeddings or use rope")
+    shape = (cfg.num_layers, num_pages, cfg.num_heads, page_size,
+             cfg.head_dim)
+    bt = jnp.full((num_slots, max_pages_per_slot(max_len, page_size)),
+                  SCRATCH_PAGE, jnp.int32)
+    return PagedKVCache(k=jnp.zeros(shape, dtype),
+                        v=jnp.zeros(shape, dtype),
+                        lengths=jnp.zeros((num_slots,), jnp.int32),
+                        block_tables=bt)
+
+
+def paged_cache_partition_specs(rules=None) -> PagedKVCache:
+    """Same table-derived TP layout as :func:`cache_partition_specs`:
+    the pool's head axis (still axis 2) shards over ``model``; lengths
+    AND block tables are replicated — every rank walks the same
+    logical-to-physical mapping over its local heads."""
+    from apex_tpu.partition import kv_cache_rules, match_partition_rules
+
+    if rules is None:
+        rules = kv_cache_rules()
+    template = PagedKVCache(
+        k=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
+        v=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
+        lengths=jax.ShapeDtypeStruct((1,), "int32"),
+        block_tables=jax.ShapeDtypeStruct((1, 1), "int32"))
     return match_partition_rules(rules, template)

@@ -32,10 +32,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.models.gpt import (
-    GPTConfig, GPTModel, _block_decode, _block_prefill, _ln,
-    _rope_or_none, _tied_lm_logits,
+    GPTConfig, GPTModel, _block_decode, _block_decode_paged,
+    _block_prefill, _ln, _rope_or_none, _tied_lm_logits,
 )
-from apex_tpu.serving.cache import KVCache, cache_partition_specs
+from apex_tpu.serving.cache import (
+    KVCache, PagedKVCache, cache_partition_specs,
+    paged_cache_partition_specs,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +107,101 @@ def _decode_core(params, cfg: GPTConfig, cache: KVCache, tokens, active,
 
 
 # ---------------------------------------------------------------------------
+# paged cores — same forwards, block-table indirection into the pool
+# ---------------------------------------------------------------------------
+
+def _paged_prefill_core(params, cfg: GPTConfig, cache: PagedKVCache, ids,
+                        mask, slot, write_pages, table_row, *, embed_fn,
+                        dense_fns, logits_fn):
+    """Bucketed prefill into the page pool. The forward is IDENTICAL to
+    :func:`_prefill_core` (flash attention over the padded prompt); only
+    the cache write differs: the stacked per-layer k/v tiles are cut
+    into whole pages and scattered to ``write_pages`` (one physical
+    page per bucket page — the host redirects prefix-shared pages and
+    the pad tail to ``SCRATCH_PAGE``, so shared pages are never
+    rewritten), and ``table_row`` ((max_pages,) int32, NULL-padded)
+    becomes the slot's block-table row. One compiled executable per
+    bucket, independent of how many pages are shared."""
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(f"prefill takes one slot's (1, s) ids, got "
+                         f"{ids.shape}")
+    s = ids.shape[1]
+    page_size = cache.k.shape[3]
+    if s % page_size:
+        raise ValueError(f"prompt bucket {s} is not a multiple of "
+                         f"page_size {page_size}")
+    n_bucket_pages = s // page_size
+    if write_pages.shape != (n_bucket_pages,):
+        raise ValueError(f"write_pages {write_pages.shape} != one page "
+                         f"per bucket page ({n_bucket_pages},)")
+    if table_row.shape != (cache.block_tables.shape[1],):
+        raise ValueError(f"table_row {table_row.shape} != block-table "
+                         f"row ({cache.block_tables.shape[1]},)")
+    x = embed_fn(params, ids)
+    freqs = _rope_or_none(cfg, s)
+    key_mask = mask[None, :]
+
+    def body(x, lp):
+        x, k, v = _block_prefill(lp, x, cfg, freqs, key_mask, *dense_fns)
+        return x, (k, v)
+
+    x, (k, v) = lax.scan(body, x, params["layers"])
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    length = jnp.sum(mask).astype(jnp.int32)
+    h_last = lax.dynamic_slice_in_dim(hidden, length - 1, 1, 1)[:, 0]
+    logits = logits_fn(params, h_last)
+    mz = mask.astype(k.dtype)[None, None, None, :, None]
+
+    def tiles(t, pool):
+        # (L, 1, nh, s, hd) -> page tiles (L, n_bucket_pages, nh,
+        # page_size, hd), zero-padded tail included (scratch eats it)
+        lyr, _, nh, _, hd = t.shape
+        t = (t * mz).astype(pool.dtype)[:, 0]
+        t = t.reshape(lyr, nh, n_bucket_pages, page_size, hd)
+        return t.transpose(0, 2, 1, 3, 4)
+
+    new = PagedKVCache(
+        k=cache.k.at[:, write_pages].set(tiles(k, cache.k)),
+        v=cache.v.at[:, write_pages].set(tiles(v, cache.v)),
+        lengths=lax.dynamic_update_slice(cache.lengths, length[None],
+                                         (slot,)),
+        block_tables=lax.dynamic_update_slice(
+            cache.block_tables, table_row[None, :], (slot, 0)))
+    return new, logits
+
+
+def _paged_decode_core(params, cfg: GPTConfig, cache: PagedKVCache,
+                       tokens, active, *, embed_fn, dense_fns,
+                       logits_fn):
+    """One token for every slot against the page pool; the host has
+    already made every slot's write target exclusive (page-boundary
+    allocation + copy-on-write happen in
+    ``PagedDecodeEngine.prepare_decode`` BEFORE this runs). Block
+    tables are host-owned state riding the donated cache tuple; they
+    come back numerically unchanged, but through a self-row rewrite
+    rather than an invar passthrough — an output that IS the invar
+    gives XLA nothing to land the donation in, and APX512 would flag
+    the dropped alias pair."""
+    pos = cache.lengths
+    bt = cache.block_tables
+    x = embed_fn(params, tokens[:, None], pos=pos)
+    freqs = _rope_or_none(cfg, bt.shape[1] * cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kp, vp = layer_slice
+        x, kp, vp = _block_decode_paged(lp, x, kp, vp, bt, pos, cfg,
+                                        freqs, *dense_fns)
+        return x, (kp, vp)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden[:, 0])
+    bt = lax.dynamic_update_slice(
+        bt, lax.dynamic_slice(bt, (0, 0), (1, bt.shape[1])), (0, 0))
+    return PagedKVCache(k, v, jnp.where(active, pos + 1, pos), bt), logits
+
+
+# ---------------------------------------------------------------------------
 # unsharded (single-chip) builders
 # ---------------------------------------------------------------------------
 
@@ -161,6 +259,53 @@ def make_decode_fn(cfg: GPTConfig, compute_dtype=None):
                             logits_fn=_logits_unsharded)
 
     return jax.jit(decode, donate_argnums=1)
+
+
+def make_paged_prefill_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(paged prefill), cache DONATED (4 alias pairs: pool k/v,
+    lengths, block tables). Compiles per bucket, like the dense path."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def prefill(params, cache, ids, mask, slot, write_pages, table_row):
+        return _paged_prefill_core(params, cfg, cache, ids, mask, slot,
+                                   write_pages, table_row,
+                                   embed_fn=embed,
+                                   dense_fns=(_dense,) * 4,
+                                   logits_fn=_logits_unsharded)
+
+    return jax.jit(prefill, donate_argnums=1)
+
+
+def make_paged_decode_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(paged decode), cache DONATED; one executable per pool
+    shape."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def decode(params, cache, tokens, active):
+        return _paged_decode_core(params, cfg, cache, tokens, active,
+                                  embed_fn=embed,
+                                  dense_fns=(_dense,) * 4,
+                                  logits_fn=_logits_unsharded)
+
+    return jax.jit(decode, donate_argnums=1)
+
+
+def make_copy_page_fn():
+    """jit(copy one physical page across all layers), cache DONATED —
+    the device half of copy-on-write: the host picks ``src``/``dst``
+    (``PagePool.needs_copy``), this clones the rows so the shared
+    original is never mutated. Scalar page ids keep it one executable
+    regardless of which pages diverge."""
+
+    def copy(cache, src, dst):
+        def clone(pool):
+            page = lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+            return lax.dynamic_update_slice_in_dim(pool, page, dst,
+                                                   axis=1)
+
+        return cache._replace(k=clone(cache.k), v=clone(cache.v))
+
+    return jax.jit(copy, donate_argnums=0)
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +378,53 @@ def make_tp_decode_fn(model: GPTModel, mesh=None):
         return _decode_core(params, cfg, cache, tokens, active,
                             embed_fn=embed, dense_fns=dense_fns,
                             logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        decode, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_paged_prefill_fn(model: GPTModel, mesh=None):
+    """TP paged prefill: the pool's head axis shards over ``model``;
+    block tables / page ids are replicated host decisions, so every
+    rank scatters its local heads' tiles to the same physical pages."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = paged_cache_partition_specs()
+
+    def prefill(params, cache, ids, mask, slot, write_pages, table_row):
+        return _paged_prefill_core(params, cfg, cache, ids, mask, slot,
+                                   write_pages, table_row,
+                                   embed_fn=embed, dense_fns=dense_fns,
+                                   logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        prefill, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P(), P(), P(), P(),
+                  P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_paged_decode_fn(model: GPTModel, mesh=None):
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = paged_cache_partition_specs()
+
+    def decode(params, cache, tokens, active):
+        return _paged_decode_core(params, cfg, cache, tokens, active,
+                                  embed_fn=embed, dense_fns=dense_fns,
+                                  logits_fn=logits_fn)
 
     sharded = ps.shard_map(
         decode, mesh=mesh,

@@ -1,0 +1,170 @@
+"""Host-side page allocator: free list, refcounts, prefix cache.
+
+The device half of paging (``serving.cache.PagedKVCache``) is dumb
+storage — a fixed pool of ``(heads, page_size, head_dim)`` pages per
+layer plus per-slot block tables. Everything that decides WHICH page a
+logical position lives in happens here, on the host, in plain Python:
+
+- **free list + refcounts** — ``alloc()`` hands out exclusively-owned
+  pages (refcount 1); ``retain``/``release`` move shared pages between
+  owners; a page returns to the free list when its last reference
+  drops. Page ids below ``RESERVED_PAGES`` (the null and scratch pages)
+  are never allocated.
+- **prefix cache** — completed prompt pages register under a CHAINED
+  content hash (``prefix_page_keys``): page ``i``'s key commits to
+  every token of pages ``0..i``, so a registry hit at key ``i`` means
+  the whole prefix matches, not just one page. ``match_prefix`` walks
+  the longest registered chain and retains each hit for the caller —
+  two requests sharing a system prompt then hold the SAME physical
+  pages (stored once, refcounted). The registry holds its own +1 ref
+  per page so cached prefixes survive the submitting request.
+- **copy-on-write** — appending a row into a page some other owner
+  (another slot or the registry) can still read MUST NOT mutate it.
+  ``needs_copy`` is exactly ``refcount > 1``; the engine copies the
+  page device-side, releases the shared original, and repoints its
+  block table. The cached/shared copy is never perturbed — the
+  acceptance contract ``tests/L0/run_serving/test_paging.py`` pins.
+- **eviction** — when the free list runs dry, ``alloc()`` drops
+  least-recently-used prefix-cache entries (releasing the registry's
+  refs) until a page frees or the registry is empty; only then does it
+  return ``None`` and the engine preempts.
+
+Determinism: nothing here touches device state or RNG — identical
+request streams replay identical page decisions, and the decode math
+is placement-invariant anyway (see ``_paged_decode_attention``).
+"""
+
+import hashlib
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.serving.cache import RESERVED_PAGES
+
+
+def prefix_page_keys(tokens: Sequence[int],
+                     page_size: int) -> List[bytes]:
+    """One chained content key per page of ``tokens`` (the last page
+    may be partial — its key commits to the partial contents, so only
+    an EXACT partial match shares it)."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be positive, got {page_size}")
+    keys: List[bytes] = []
+    h = b""
+    for start in range(0, len(tokens), page_size):
+        page = tuple(int(t) for t in tokens[start:start + page_size])
+        h = hashlib.sha256(h + repr(page).encode()).digest()
+        keys.append(h)
+    return keys
+
+
+class PagePool:
+    """Free list + per-page refcounts + LRU prefix registry (see
+    module doc). ``free_order`` overrides the initial free-list order —
+    the placement bit-identity tests admit the same requests through
+    permuted orders and require identical logits."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 free_order: Optional[Sequence[int]] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        if num_pages <= RESERVED_PAGES:
+            raise ValueError(
+                f"num_pages {num_pages} must exceed the "
+                f"{RESERVED_PAGES} reserved pages")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        usable = range(RESERVED_PAGES, num_pages)
+        if free_order is None:
+            free_order = list(usable)
+        if sorted(free_order) != list(usable):
+            raise ValueError(
+                f"free_order must be a permutation of {usable}")
+        self._free = deque(free_order)
+        self._ref: Dict[int, int] = {}  # page -> refcount; absent = free
+        # chained prefix key -> page holding that page's rows; each
+        # entry owns one reference on its page; insertion order = LRU
+        self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+
+    # -- refcounting ------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._prefix)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def needs_copy(self, page: int) -> bool:
+        """True when appending a row into ``page`` would be observable
+        by another owner (slot or prefix registry) — the COW trigger."""
+        return self.refcount(page) > 1
+
+    def alloc(self) -> Optional[int]:
+        """An exclusively-owned page (refcount 1), evicting LRU prefix
+        entries as needed; None when genuinely out of pages."""
+        while not self._free and self._prefix:
+            key, page = self._prefix.popitem(last=False)
+            self.release(page)
+        if not self._free:
+            return None
+        page = self._free.popleft()
+        self._ref[page] = 1
+        return page
+
+    def retain(self, page: int) -> None:
+        if page not in self._ref:
+            raise ValueError(f"retain of free/reserved page {page}")
+        self._ref[page] += 1
+
+    def release(self, page: int) -> None:
+        ref = self._ref.get(page, 0)
+        if ref <= 0:
+            raise ValueError(f"release of free/reserved page {page}")
+        if ref == 1:
+            del self._ref[page]
+            self._free.append(page)
+        else:
+            self._ref[page] = ref - 1
+
+    # -- prefix cache -----------------------------------------------------
+
+    def match_prefix(self, keys: Sequence[bytes]) -> List[int]:
+        """Pages of the longest registered chain prefix of ``keys``,
+        each RETAINED for the caller (the admitting slot takes one
+        reference per shared page; release on free/preempt)."""
+        pages: List[int] = []
+        for key in keys:
+            page = self._prefix.get(key)
+            if page is None:
+                break
+            self._prefix.move_to_end(key)  # LRU refresh
+            self.retain(page)
+            pages.append(page)
+        return pages
+
+    def register_prefix(self, keys: Sequence[bytes],
+                        pages: Sequence[int]) -> None:
+        """Publish a prompt's page chain for future sharing. New
+        entries take the registry's own reference; keys already
+        registered are only LRU-refreshed (their pages stay shared)."""
+        if len(keys) != len(pages):
+            raise ValueError(
+                f"{len(keys)} keys vs {len(pages)} pages")
+        for key, page in zip(keys, pages):
+            if key in self._prefix:
+                self._prefix.move_to_end(key)
+                continue
+            self.retain(page)
+            self._prefix[key] = page
+
+    def evict_prefix(self, key: bytes) -> bool:
+        """Drop one registry entry (tests / explicit invalidation)."""
+        page = self._prefix.pop(key, None)
+        if page is None:
+            return False
+        self.release(page)
+        return True

@@ -22,15 +22,22 @@ The engine's cache is DONATED to each jitted step (see
 
 import dataclasses
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.models.gpt import GPTConfig
-from apex_tpu.serving.cache import init_cache
-from apex_tpu.serving.decode import make_decode_fn, make_prefill_fn
+from apex_tpu.serving.cache import (
+    NULL_PAGE, RESERVED_PAGES, SCRATCH_PAGE, init_cache,
+    init_paged_cache, max_pages_per_slot,
+)
+from apex_tpu.serving.decode import (
+    make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
+    make_paged_prefill_fn, make_prefill_fn,
+)
+from apex_tpu.serving.paging import PagePool, prefix_page_keys
 from apex_tpu.serving.sampling import sample_tokens
 from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
 
@@ -60,6 +67,8 @@ class DecodeEngine:
     (bucketed prefill, batched decode, sampling). ``top_k`` is static —
     an engine setting, compiled into the sampler."""
 
+    paged = False
+
     def __init__(self, params, cfg: GPTConfig, num_slots: int,
                  max_len: int, cache_dtype=jnp.bfloat16, top_k: int = 0,
                  buckets: Optional[Sequence[int]] = None,
@@ -80,9 +89,11 @@ class DecodeEngine:
         self._decode = make_decode_fn(cfg, compute_dtype)
         self._sample = jax.jit(sample_tokens, static_argnames="top_k")
 
-    def prefill(self, slot: int, prompt: Sequence[int]) -> jax.Array:
+    def prefill(self, slot: int,
+                prompt: Sequence[int]) -> Optional[jax.Array]:
         """Run the full forward over ``prompt`` into cache row ``slot``;
-        returns the last-real-token logits (1, V)."""
+        returns the last-real-token logits (1, V). (The paged engine
+        may instead return None — out of pages, admission must wait.)"""
         ids = np.asarray(prompt, np.int32)[None, :]
         ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
         self.cache, logits = self._prefill(
@@ -98,6 +109,166 @@ class DecodeEngine:
 
     def sample(self, logits, keys, temperature) -> jax.Array:
         return self._sample(logits, keys, temperature, top_k=self.top_k)
+
+    # scheduler hooks, no-ops for the dense engine: a cache row needs
+    # no per-token capacity and frees by being overwritten
+    def page_demand(self, total_len: int) -> None:
+        """Validate a request's worst-case capacity need at submit."""
+
+    def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
+        """Make every slot's next write target exclusive; returns slots
+        that had to be preempted (none for the dense cache)."""
+        return []
+
+    def free_slot(self, slot: int) -> None:
+        """Release slot-owned resources on eviction/preemption."""
+
+
+class PagedDecodeEngine(DecodeEngine):
+    """:class:`DecodeEngine` over the paged cache: a fixed page pool,
+    per-slot block tables, and a host-side :class:`PagePool` deciding
+    placement. Adds prefix sharing at admission (page runs keyed by the
+    chained prompt-prefix hash are retained instead of recomputed —
+    including a partial last page on an exact match) and copy-on-write:
+    ``prepare_decode`` runs before every decode tick to allocate
+    page-boundary pages and clone any shared page a slot is about to
+    append into, so the jitted decode step only ever writes
+    exclusively-owned (or scratch) pages.
+
+    ``free_order`` permutes the initial free list — physical placement
+    is an allocator detail the logits provably don't depend on (the
+    bit-identity tests drive different orders through this knob).
+    """
+
+    paged = True
+
+    def __init__(self, params, cfg: GPTConfig, num_slots: int,
+                 max_len: int, num_pages: int, page_size: int,
+                 cache_dtype=jnp.bfloat16, top_k: int = 0,
+                 buckets: Optional[Sequence[int]] = None,
+                 compute_dtype=None,
+                 free_order: Optional[Sequence[int]] = None,
+                 prefix_sharing: bool = True):
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.page_size = page_size
+        self.max_pages = max_pages_per_slot(max_len, page_size)
+        self.prefix_sharing = prefix_sharing
+        if buckets is None:
+            buckets = default_buckets(max_len, min(128, max_len))
+        self.buckets = tuple(sorted({min(int(b), max_len)
+                                     for b in buckets}))
+        bad = [b for b in self.buckets if b % page_size]
+        if bad:
+            raise ValueError(
+                f"paged prefill writes whole pages: buckets {bad} are "
+                f"not multiples of page_size {page_size}")
+        self.top_k = top_k
+        self.cache = init_paged_cache(cfg, num_slots, max_len, num_pages,
+                                      page_size, cache_dtype)
+        self.pool = PagePool(num_pages, page_size, free_order)
+        self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
+        self._prefill = make_paged_prefill_fn(cfg, compute_dtype)
+        self._decode = make_paged_decode_fn(cfg, compute_dtype)
+        self._copy = make_copy_page_fn()
+        self._sample = jax.jit(sample_tokens, static_argnames="top_k")
+
+    def page_demand(self, total_len: int) -> None:
+        need = max_pages_per_slot(min(total_len, self.max_len),
+                                  self.page_size)
+        usable = self.pool.num_pages - RESERVED_PAGES
+        if need > usable:
+            raise ValueError(
+                f"request needs up to {need} pages but the pool only "
+                f"has {usable} usable pages")
+
+    def prefill(self, slot: int,
+                prompt: Sequence[int]) -> Optional[jax.Array]:
+        """Admit ``prompt`` into ``slot``: share the longest cached
+        prefix run, allocate private pages for the rest, register the
+        chain for future requests, and prefill — writing ONLY the
+        private pages (shared ones are redirected to scratch; their
+        rows were produced by the original request and are reused
+        verbatim). Returns None when the pool can't cover the prompt
+        even after LRU eviction — the caller requeues."""
+        toks = [int(t) for t in prompt]
+        n_pages = max_pages_per_slot(len(toks), self.page_size)
+        keys = prefix_page_keys(toks, self.page_size)
+        shared = self.pool.match_prefix(keys) if self.prefix_sharing \
+            else []
+        private: List[int] = []
+        for _ in range(n_pages - len(shared)):
+            p = self.pool.alloc()
+            if p is None:
+                for q in shared + private:
+                    self.pool.release(q)
+                return None
+            private.append(p)
+        pages = shared + private
+        if self.prefix_sharing:
+            self.pool.register_prefix(keys, pages)
+        self._slot_pages[slot] = list(pages)
+
+        ids = np.asarray(toks, np.int32)[None, :]
+        ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
+        write = np.full((ids.shape[1] // self.page_size,), SCRATCH_PAGE,
+                        np.int32)
+        write[len(shared):n_pages] = private
+        row = np.full((self.max_pages,), NULL_PAGE, np.int32)
+        row[:n_pages] = pages
+        self.cache, logits = self._prefill(
+            self.params, self.cache, ids, mask, jnp.int32(slot),
+            jnp.asarray(write), jnp.asarray(row))
+        return logits
+
+    def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
+        """Before a decode tick writes row ``pos`` for each slot: cross
+        a page boundary by allocating a fresh page, and clone (COW) a
+        shared page about to receive an appended row. A slot the pool
+        cannot serve even after LRU eviction is preempted — its pages
+        are released (often unblocking the rest of the batch) and the
+        caller requeues the request."""
+        preempted: List[int] = []
+        for i, pos in sorted(positions.items()):
+            pages = self._slot_pages[i]
+            idx = pos // self.page_size
+            if idx == len(pages):                       # page boundary
+                p = self.pool.alloc()
+                if p is None:
+                    self.free_slot(i)
+                    preempted.append(i)
+                    continue
+                pages.append(p)
+                self.cache = self.cache._replace(
+                    block_tables=self.cache.block_tables.at[i, idx].set(p))
+            elif self.pool.needs_copy(pages[idx]):      # COW
+                dst = self.pool.alloc()
+                if dst is None:
+                    self.free_slot(i)
+                    preempted.append(i)
+                    continue
+                self.cache = self._copy(self.cache,
+                                        jnp.int32(pages[idx]),
+                                        jnp.int32(dst))
+                self.cache = self.cache._replace(
+                    block_tables=self.cache.block_tables.at[i, idx].set(
+                        dst))
+                self.pool.release(pages[idx])
+                pages[idx] = dst
+        return preempted
+
+    def free_slot(self, slot: int) -> None:
+        """Release the slot's page references and park its block-table
+        row on scratch (a freed slot's parked decode writes must never
+        land in a page the allocator may hand to someone else)."""
+        for p in self._slot_pages[slot]:
+            self.pool.release(p)
+        self._slot_pages[slot] = []
+        self.cache = self.cache._replace(
+            block_tables=self.cache.block_tables.at[slot].set(
+                jnp.full((self.max_pages,), SCRATCH_PAGE, jnp.int32)))
 
 
 class ContinuousBatchingScheduler:
@@ -118,11 +289,17 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"prompt length {len(request.prompt)} exceeds cache "
                 f"max_len {self.engine.max_len}")
-        # fail fast at submit, not mid-run inside _admit
+        # fail fast at submit, not mid-run inside _admit: the prompt
+        # must have a bucket rung and (paged) fit the pool even running
+        # alone at its worst-case generated length
         bucket_for(len(request.prompt), self.engine.buckets)
+        self.engine.page_demand(
+            len(request.prompt) + request.max_new_tokens)
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, request))
+        # third element: tokens already generated — empty for fresh
+        # submissions, carried through preemption-by-requeue
+        self._queue.append((rid, request, []))
         return rid
 
     def _slot_key(self, slot: _Slot) -> jax.Array:
@@ -134,14 +311,30 @@ class ContinuousBatchingScheduler:
         for i in range(eng.num_slots):
             if self._slots[i] is not None or not self._queue:
                 continue
-            rid, req = self._queue.popleft()
-            slot = _Slot(rid, req, len(req.prompt), [], len(req.prompt))
-            logits = eng.prefill(i, req.prompt)
-            # the FIRST generated token comes from the prefill logits
-            tok = int(eng.sample(
-                logits, self._slot_key(slot)[None, :],
-                jnp.asarray([req.temperature], jnp.float32))[0])
-            slot.generated.append(tok)
+            rid, req, resume = self._queue[0]
+            # a preempted request resumes by re-prefilling everything
+            # it had produced EXCEPT its last sampled token, which the
+            # next decode tick feeds (the normal teacher-forcing shape)
+            tokens = tuple(req.prompt) + tuple(resume[:-1])
+            logits = eng.prefill(i, tokens)
+            if logits is None:
+                # out of pages: keep FIFO order, wait for evictions
+                if all(s is None for s in self._slots):
+                    raise RuntimeError(
+                        "page pool cannot admit the queue head even "
+                        "with every slot free — submit-time validation "
+                        "should have rejected it")
+                break
+            self._queue.popleft()
+            slot = _Slot(rid, req, len(req.prompt), list(resume),
+                         len(tokens))
+            if not resume:
+                # the FIRST generated token comes from the prefill
+                # logits; on resume it already exists
+                tok = int(eng.sample(
+                    logits, self._slot_key(slot)[None, :],
+                    jnp.asarray([req.temperature], jnp.float32))[0])
+                slot.generated.append(tok)
             self._slots[i] = slot
             self._maybe_evict(i)
 
@@ -153,9 +346,22 @@ class ContinuousBatchingScheduler:
         if done:
             self._results[slot.request_id] = list(slot.generated)
             self._slots[i] = None
+            self.engine.free_slot(i)
 
     def _tick(self) -> None:
         eng = self.engine
+        # give every occupied slot an exclusive write target for this
+        # tick; slots the pool can't serve are preempted back to the
+        # queue FRONT with their progress (sampling keys depend only on
+        # (seed, n_generated), so a resumed request continues its
+        # original stream bit-for-bit)
+        positions = {i: s.pos for i, s in enumerate(self._slots)
+                     if s is not None}
+        for i in reversed(eng.prepare_decode(positions)):
+            s = self._slots[i]
+            self._queue.appendleft((s.request_id, s.request,
+                                    list(s.generated)))
+            self._slots[i] = None
         occupied = [s for s in self._slots if s is not None]
         if not occupied:
             return

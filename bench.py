@@ -570,11 +570,19 @@ def _decode_bench_setup(on_tpu, cache_dtype, slots=None):
     return body, make_init, fetch, slots, s_max, cfg
 
 
-def _decode_model_bytes(cfg, slots, depth, param_dtype, cache_dtype):
-    """HBM bytes per generated token from the APX6xx abstract cost
-    interpreter, over the same decode program at the parked cache
-    depth. Pure trace — no compile, no device work — so it prices the
-    roofline the measured tokens/sec should be compared against."""
+def _decode_cost_numbers(cfg, slots, depth, param_dtype, cache_dtype):
+    """(model_bytes_per_token, kv_bytes_per_step) from the APX6xx
+    abstract cost interpreter, over the same decode program at the
+    parked cache depth. Pure trace — no compile, no device work — so it
+    prices the roofline the measured tokens/sec should be compared
+    against. ``kv_bytes_per_step`` isolates the cache slice of that
+    traffic: the full K/V read (both cache invars, charged once per
+    step by the interpreter) plus the in-place row writes
+    (``delta_write_bytes``) — exactly the term the paged layout makes
+    length-proportional (see the ``decode_paged_vs_dense`` A/B pair and
+    BASELINE r10)."""
+    import math
+
     from apex_tpu.lint.traced import cost
     from apex_tpu.models.gpt import init_gpt
     from apex_tpu.serving.cache import init_cache
@@ -588,7 +596,10 @@ def _decode_model_bytes(cfg, slots, depth, param_dtype, cache_dtype):
         params, cache, jax.ShapeDtypeStruct((slots,), jnp.int32),
         jax.ShapeDtypeStruct((slots,), jnp.bool_))
     rep = cost.compute(closed, __file__, "gpt_decode")
-    return int(rep.hbm_total_bytes // slots)
+    kv_read = sum(math.prod(t.shape) * t.dtype.itemsize
+                  for t in (cache.k, cache.v))
+    return (int(rep.hbm_total_bytes // slots),
+            int(kv_read + rep.delta_write_bytes))
 
 
 def bench_gpt_decode(on_tpu):
@@ -612,12 +623,97 @@ def bench_gpt_decode(on_tpu):
                   "cache_dtype": "bfloat16",
                   "per_token_latency_ms": round(dt * 1e3, 3)})
     try:
-        extra["model_bytes_per_token"] = _decode_model_bytes(
-            cfg, slots, s_max // 2,
-            jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16)
+        extra["model_bytes_per_token"], extra["kv_bytes_per_step"] = \
+            _decode_cost_numbers(
+                cfg, slots, s_max // 2,
+                jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16)
     except Exception as e:  # static cross-check must never sink the bench
         extra["model_bytes_per_token_error"] = repr(e)
     emit(metric, slots / dt, "tokens/sec", extra=extra)
+
+
+def _paged_vs_dense_decode_ab_pair(on_tpu):
+    """(side_a, side_b): paged ragged-length decode vs the dense
+    slots x S_max step — prices the length-proportional K/V read the
+    page pool banks on. Same medium shape and uniform 32..512 ragged
+    ladder as the ``gpt_paged_decode_step_medium_ragged`` cost entry
+    (BASELINE r10), so the measured ratio lands next to the static
+    ~40% K/V-read cut. ``active`` is all-False on BOTH sides: lengths
+    never advance, so every scan iteration re-measures the same
+    in-range program (no page-boundary host work inside the timed
+    region); the argmax token feedback keeps the chain
+    data-dependent. Params are closed over, not threaded — the
+    non-donating A/B harness already holds two caches per side."""
+    import dataclasses
+
+    from apex_tpu.models.gpt import GPTConfig, gpt_tiny, init_gpt
+    from apex_tpu.serving.cache import (
+        NULL_PAGE, RESERVED_PAGES, init_cache, init_paged_cache,
+        max_pages_per_slot,
+    )
+    from apex_tpu.serving.decode import (
+        _decode_core, _dense, _embed_unsharded, _logits_unsharded,
+        _paged_decode_core,
+    )
+
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        ffn_hidden_size=4096, vocab_size=50304,
+                        max_position_embeddings=1024, use_rope=True,
+                        hidden_dropout=0.0)
+        slots, s_max, page = 32, 512, 64
+        param_dtype = jnp.bfloat16
+    else:
+        cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                                  hidden_dropout=0.0)
+        slots, s_max, page = 4, 64, 16
+        param_dtype = jnp.float32
+    lo = s_max // 16
+    lengths = [lo + round(i * (s_max - lo) / (slots - 1))
+               for i in range(slots)]
+    params = init_gpt(jax.random.PRNGKey(0), cfg, param_dtype)
+    embed = _embed_unsharded(cfg, None)
+    lengths_arr = jnp.asarray(lengths, jnp.int32)
+    active = jnp.zeros((slots,), bool)
+    tokens0 = jnp.zeros((slots,), jnp.int32)
+    M = 10 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[1]).astype(jnp.float32)  # noqa: E731
+
+    def paged_init():
+        max_pages = max_pages_per_slot(s_max, page)
+        # one mapped page run per slot, sized so the write row
+        # (pos = length) is mapped; tails stay NULL (masked zeros)
+        runs = [min(-(-(l + 1) // page), max_pages) for l in lengths]
+        cache = init_paged_cache(cfg, slots, s_max,
+                                 RESERVED_PAGES + sum(runs), page,
+                                 jnp.bfloat16)
+        rows, nxt = [], RESERVED_PAGES
+        for n in runs:
+            rows.append(list(range(nxt, nxt + n))
+                        + [NULL_PAGE] * (max_pages - n))
+            nxt += n
+        return cache._replace(
+            lengths=lengths_arr,
+            block_tables=jnp.asarray(rows, jnp.int32))
+
+    def body_a(state):
+        cache, tokens = state
+        cache, logits = _paged_decode_core(
+            params, cfg, cache, tokens, active, embed_fn=embed,
+            dense_fns=(_dense,) * 4, logits_fn=_logits_unsharded)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def body_b(state):
+        cache, tokens = state
+        cache, logits = _decode_core(
+            params, cfg, cache, tokens, active, embed_fn=embed,
+            dense_fns=(_dense,) * 4, logits_fn=_logits_unsharded)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+    dense_cache = init_cache(cfg, slots, s_max, jnp.bfloat16)._replace(
+        lengths=lengths_arr)
+    return (_ab_side(body_a, (paged_init(), tokens0), fetch, M),
+            _ab_side(body_b, (dense_cache, tokens0), fetch, M))
 
 
 def _decode_cache_ab_pair(on_tpu):
@@ -999,6 +1095,9 @@ AB_PAIRS = {
     "decode_cache_bf16": (
         "cache_bf16", "cache_fp32",
         _decode_cache_ab_pair),
+    "decode_paged_vs_dense": (
+        "paged_ragged", "dense_slots_x_smax",
+        _paged_vs_dense_decode_ab_pair),
 }
 
 

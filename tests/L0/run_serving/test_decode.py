@@ -178,3 +178,100 @@ def test_init_cache_validates():
     assert isinstance(c, KVCache) and c.k.dtype == jnp.bfloat16
     assert c.k.shape == (cfg.num_layers, 2, cfg.num_heads, 16,
                          cfg.head_dim)
+
+
+# -- paged cache ------------------------------------------------------------
+
+def _paged_teacher_forced(params, cfg, seq, free_order=None):
+    """Paged analogue of :func:`_teacher_forced`: prefill + decode via
+    :class:`PagedDecodeEngine` (page_size 8, so the 8-token prompt ends
+    exactly at a page boundary only for the default PROMPT — boundary
+    allocation and in-page appends both get exercised)."""
+    from apex_tpu.serving import PagedDecodeEngine
+
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=S_MAX,
+                            num_pages=14, page_size=8,
+                            cache_dtype=jnp.float32, buckets=(8, 16, 32),
+                            free_order=free_order)
+    logits = eng.prefill(0, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+    rows = [logits[0]]
+    for t in range(PROMPT, seq.shape[1]):
+        assert eng.prepare_decode({0: t}) == []
+        logits = eng.decode(
+            jnp.asarray([int(seq[0, t]), 0], jnp.int32),
+            jnp.asarray([True, False]))
+        rows.append(logits[0])
+    return jnp.stack(rows)
+
+
+@pytest.mark.parametrize("use_rope", [True, False],
+                         ids=["rope", "learned_pos"])
+def test_paged_decode_matches_full_forward(use_rope):
+    """The serving headline contract holds through the page
+    indirection: paged incremental decode == full-sequence forward to
+    fp32 tolerance at identical positions."""
+    cfg = _cfg(use_rope)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = jax.random.randint(jax.random.PRNGKey(1), (1, S_TOTAL), 0,
+                             cfg.vocab_size)
+    want = _full_logits(params, cfg, seq)[0, PROMPT - 1:]
+    got = _paged_teacher_forced(params, cfg, seq)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_paged_decode_bit_identical_across_page_placements():
+    """Physical page placement is an allocator detail: the same request
+    decoded through permuted free-list orders must produce
+    BIT-IDENTICAL logits at every step (masked scores are exactly
+    zeroed in the softmax, so unmapped/garbage pages contribute exactly
+    0.0 — tolerance would hide a real leak)."""
+    from apex_tpu.serving.cache import RESERVED_PAGES
+
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = jax.random.randint(jax.random.PRNGKey(1), (1, S_TOTAL), 0,
+                             cfg.vocab_size)
+    usable = list(range(RESERVED_PAGES, 14))
+    rng = np.random.RandomState(3)
+    orders = [None, list(reversed(usable)),
+              list(rng.permutation(usable))]
+    runs = [np.asarray(_paged_teacher_forced(params, cfg, seq, order))
+            for order in orders]
+    for other in runs[1:]:
+        np.testing.assert_array_equal(runs[0], other)
+
+
+def test_paged_dense_logits_agree():
+    """Paged and dense decode run the same math over the same rows —
+    they must agree to tight fp32 tolerance at every step (not bitwise:
+    the attention reductions are differently shaped programs)."""
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = jax.random.randint(jax.random.PRNGKey(1), (1, S_TOTAL), 0,
+                             cfg.vocab_size)
+    dense = np.asarray(_teacher_forced(params, cfg, seq))
+    paged = np.asarray(_paged_teacher_forced(params, cfg, seq))
+    np.testing.assert_allclose(paged, dense, rtol=1e-5, atol=1e-5)
+
+
+def test_init_paged_cache_validates():
+    from apex_tpu.serving import init_paged_cache
+    from apex_tpu.serving.cache import (
+        PagedKVCache, RESERVED_PAGES, SCRATCH_PAGE,
+    )
+
+    cfg = _cfg(False)
+    with pytest.raises(ValueError, match="position table"):
+        init_paged_cache(cfg, 1, cfg.max_position_embeddings + 1, 6, 16)
+    with pytest.raises(ValueError, match="positive"):
+        init_paged_cache(cfg, 0, 8, 6, 4)
+    with pytest.raises(ValueError, match="reserved"):
+        init_paged_cache(cfg, 1, 8, RESERVED_PAGES, 4)
+    c = init_paged_cache(cfg, 2, 16, 6, 4)
+    assert isinstance(c, PagedKVCache) and c.k.dtype == jnp.bfloat16
+    assert c.k.shape == (cfg.num_layers, 6, cfg.num_heads, 4,
+                         cfg.head_dim)
+    assert c.block_tables.shape == (2, 4)  # ceil(16 / 4) per slot
+    assert int(c.block_tables.min()) == SCRATCH_PAGE  # parked on scratch
+    assert int(c.block_tables.max()) == SCRATCH_PAGE

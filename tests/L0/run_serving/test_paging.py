@@ -1,0 +1,196 @@
+"""Page pool + prefix-sharing contracts: host-side allocator invariants
+(alloc/free/refcount, LRU eviction, out-of-pages behavior), stored-once
+prefix sharing, and the copy-on-write acceptance contract — a slot
+appending into a shared page must never perturb the other request's
+logits (bit-identity, not tolerance)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt import gpt_tiny, init_gpt
+from apex_tpu.serving import PagePool, PagedDecodeEngine, prefix_page_keys
+from apex_tpu.serving.cache import RESERVED_PAGES, SCRATCH_PAGE
+
+S_MAX = 32
+
+
+def _cfg():
+    return dataclasses.replace(gpt_tiny(), use_rope=True,
+                               hidden_dropout=0.0)
+
+
+def _engine(params, cfg, num_pages, page_size=4, **kw):
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=S_MAX,
+                             num_pages=num_pages, page_size=page_size,
+                             cache_dtype=jnp.float32, buckets=(16, 32),
+                             **kw)
+
+
+# -- prefix keys ------------------------------------------------------------
+
+def test_prefix_page_keys_chain():
+    """Key i commits to every token of pages 0..i: a longer prompt's
+    keys extend a shorter one's, and any token change invalidates all
+    keys from its page onward (including a partial last page)."""
+    a = prefix_page_keys([1, 2, 3, 4, 5, 6], 4)
+    b = prefix_page_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    assert len(a) == 2 and len(b) == 3
+    assert b[0] == a[0]
+    assert b[1] != a[1]  # partial page (5, 6) vs full (5, 6, 7, 8)
+    c = prefix_page_keys([1, 2, 9, 4, 5, 6], 4)
+    assert c[0] != a[0] and c[1] != a[1]
+    with pytest.raises(ValueError, match="positive"):
+        prefix_page_keys([1], 0)
+
+
+# -- PagePool ---------------------------------------------------------------
+
+def test_pool_alloc_free_refcount():
+    pool = PagePool(6, 4)
+    assert pool.num_free == 6 - RESERVED_PAGES
+    a, b = pool.alloc(), pool.alloc()
+    assert a != b and a >= RESERVED_PAGES and b >= RESERVED_PAGES
+    assert pool.refcount(a) == 1 and not pool.needs_copy(a)
+    pool.retain(a)
+    assert pool.refcount(a) == 2 and pool.needs_copy(a)
+    pool.release(a)
+    assert pool.refcount(a) == 1 and not pool.needs_copy(a)
+    pool.release(a)
+    assert pool.refcount(a) == 0 and pool.num_free == 3
+    with pytest.raises(ValueError, match="free/reserved"):
+        pool.release(a)  # double free
+    with pytest.raises(ValueError, match="free/reserved"):
+        pool.release(SCRATCH_PAGE)
+    with pytest.raises(ValueError, match="free/reserved"):
+        pool.retain(a)
+
+
+def test_pool_free_order_is_validated_permutation():
+    with pytest.raises(ValueError, match="permutation"):
+        PagePool(6, 4, free_order=[3, 4, 5])  # misses 2
+    with pytest.raises(ValueError, match="permutation"):
+        PagePool(6, 4, free_order=[0, 1, 2, 3])  # includes reserved
+    pool = PagePool(6, 4, free_order=[5, 3, 4, 2])
+    assert pool.alloc() == 5 and pool.alloc() == 3
+
+
+def test_pool_lru_eviction_and_exhaustion():
+    pool = PagePool(RESERVED_PAGES + 3, 4)
+    pages = [pool.alloc() for _ in range(3)]
+    assert pool.alloc() is None  # dry, nothing cached to evict
+    k1 = prefix_page_keys([1, 2, 3, 4], 4)
+    k2 = prefix_page_keys([5, 6, 7, 8], 4)
+    pool.register_prefix(k1, pages[:1])
+    pool.register_prefix(k2, pages[1:2])
+    for p in pages:
+        pool.release(p)
+    assert pool.num_free == 1 and pool.num_cached == 2
+    # a hit refreshes recency: k1 becomes most-recent, so the first
+    # eviction under pressure drops k2, not k1
+    hit = pool.match_prefix(k1)
+    assert hit == pages[:1]
+    pool.release(hit[0])
+    got = {pool.alloc(), pool.alloc()}  # free page + evict k2
+    assert got == {pages[1], pages[2]}
+    assert pool.match_prefix(k2) == []      # evicted
+    assert pool.match_prefix(k1) != []      # survived (refreshed)
+    pool.release(pool._prefix[k1[0]])
+    assert pool.alloc() is not None  # evicts k1, the last entry
+    assert pool.num_cached == 0 and pool.alloc() is None
+
+
+# -- engine: stored-once sharing, COW, out-of-pages -------------------------
+
+def test_prefix_shared_pages_stored_once():
+    """Two requests with the same prompt hold the SAME physical pages:
+    the second admission allocates nothing and its prefill logits are
+    bit-identical (the rows are literally the same memory)."""
+    cfg = _cfg()
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    eng = _engine(params, cfg, num_pages=10)
+    prompt = [5, 7, 11, 13, 17, 19, 23, 29]  # 2 full pages of 4
+    l0 = eng.prefill(0, prompt)
+    free_before = eng.pool.num_free
+    l1 = eng.prefill(1, prompt)
+    assert eng._slot_pages[0] == eng._slot_pages[1]
+    assert eng.pool.num_free == free_before  # zero new allocations
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    for p in eng._slot_pages[0]:
+        assert eng.pool.refcount(p) == 3  # 2 slots + registry
+
+
+def test_cow_does_not_perturb_sharing_request():
+    """The acceptance contract: two requests share a partial last
+    prompt page; both then append (triggering copy-on-write). The
+    logits of each must be BIT-IDENTICAL to a run where it decodes
+    alone — COW never mutates the shared original, and the registry's
+    cached copy survives at refcount 1."""
+    cfg = _cfg()
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    prompt = [5, 7, 11, 13, 17, 19]  # 1.5 pages of 4: partial page shared
+    div_a, div_b = 31, 37            # divergent appended tokens
+
+    def alone(slot, token):
+        eng = _engine(params, cfg, num_pages=12)
+        logits = eng.prefill(slot, prompt)
+        assert eng.prepare_decode({slot: len(prompt)}) == []
+        toks = [0, 0]
+        toks[slot] = token
+        active = jnp.asarray([i == slot for i in range(2)])
+        step = eng.decode(jnp.asarray(toks, jnp.int32), active)
+        return np.asarray(logits), np.asarray(step[slot])
+
+    ref_pre_a, ref_a = alone(0, div_a)
+    ref_pre_b, ref_b = alone(1, div_b)
+
+    eng = _engine(params, cfg, num_pages=12)
+    pre_a = eng.prefill(0, prompt)
+    pre_b = eng.prefill(1, prompt)
+    shared = eng._slot_pages[0][1]
+    assert eng.pool.refcount(shared) == 3  # 2 slots + registry
+    assert eng.prepare_decode({0: len(prompt), 1: len(prompt)}) == []
+    # both slots COW'd the partial page to distinct private copies; the
+    # registry keeps the pristine original
+    assert eng._slot_pages[0][1] != shared
+    assert eng._slot_pages[1][1] != shared
+    assert eng._slot_pages[0][1] != eng._slot_pages[1][1]
+    assert eng.pool.refcount(shared) == 1
+    step = eng.decode(jnp.asarray([div_a, div_b], jnp.int32),
+                      jnp.asarray([True, True]))
+    np.testing.assert_array_equal(np.asarray(pre_a), ref_pre_a)
+    np.testing.assert_array_equal(np.asarray(pre_b), ref_pre_b)
+    np.testing.assert_array_equal(np.asarray(step[0]), ref_a)
+    np.testing.assert_array_equal(np.asarray(step[1]), ref_b)
+    # the cached prefix is still shareable after both divergences
+    eng2_pages = eng.pool.match_prefix(
+        prefix_page_keys(prompt, eng.page_size))
+    assert len(eng2_pages) == 2 and eng2_pages[1] == shared
+
+
+def test_prefill_returns_none_when_out_of_pages():
+    """An admission the pool can't cover (even after LRU eviction)
+    returns None and leaks nothing — every transient reference is
+    rolled back so the request can be retried after evictions."""
+    cfg = _cfg()
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    eng = _engine(params, cfg, num_pages=RESERVED_PAGES + 3,
+                  prefix_sharing=False)
+    assert eng.prefill(0, [5, 7, 11, 13, 17, 19, 23, 29]) is not None
+    free_before = eng.pool.num_free
+    assert eng.prefill(1, [2, 3, 4, 6, 8, 9, 10, 12]) is None
+    assert eng.pool.num_free == free_before  # rollback, no leak
+    eng.free_slot(0)
+    assert eng.pool.num_free == 3
+
+
+def test_page_demand_rejects_oversized_requests():
+    cfg = _cfg()
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    eng = _engine(params, cfg, num_pages=RESERVED_PAGES + 3)
+    eng.page_demand(12)  # 3 pages: fits
+    with pytest.raises(ValueError, match="pages"):
+        eng.page_demand(13)  # 4 pages > 3 usable

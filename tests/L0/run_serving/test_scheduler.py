@@ -1,5 +1,7 @@
 """Continuous-batching scheduler: admit/evict lifecycle over a fixed
-slot pool, and output invariance to slot placement and pool size."""
+slot pool, and output invariance to slot placement and pool size —
+including the paged engine (page placement, pool pressure, and
+preemption-by-requeue must all be invisible in the outputs)."""
 
 import dataclasses
 
@@ -8,7 +10,7 @@ import pytest
 
 from apex_tpu.models.gpt import gpt_tiny, init_gpt
 from apex_tpu.serving import (ContinuousBatchingScheduler, DecodeEngine,
-                              Request)
+                              PagedDecodeEngine, Request)
 
 EOS = 0
 MAX_LEN = 32
@@ -102,3 +104,107 @@ def test_run_on_empty_queue():
                           max_len=MAX_LEN)
     sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
     assert sched.run() == []
+
+
+# -- paged engine -----------------------------------------------------------
+
+def _run_paged(params, cfg, requests, num_slots, num_pages, page_size=4,
+               free_order=None):
+    engine = PagedDecodeEngine(params, cfg, num_slots=num_slots,
+                               max_len=MAX_LEN, num_pages=num_pages,
+                               page_size=page_size, buckets=(16, 32),
+                               free_order=free_order)
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    for r in requests:
+        sched.submit(r)
+    return sched.run(), engine
+
+
+def _mixed_requests():
+    return [Request(prompt=(7, 11, 13), max_new_tokens=5),
+            Request(prompt=(17, 19), max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=(7, 11, 13, 29), max_new_tokens=4),
+            Request(prompt=(7, 11, 13), max_new_tokens=5,
+                    temperature=0.7, seed=9)]
+
+
+def test_paged_outputs_match_dense():
+    """The paged engine is a drop-in for the dense one: the same
+    request mix (greedy + seeded sampling, shared prompt prefixes)
+    through the same scheduler produces identical token streams."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _mixed_requests()
+    engine = DecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                          buckets=(16, 32))
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    for r in reqs:
+        sched.submit(r)
+    dense = sched.run()
+    paged, _ = _run_paged(params, cfg, reqs, num_slots=2, num_pages=20)
+    assert paged == dense
+
+
+def test_paged_outputs_independent_of_page_placement():
+    """Permuted free-list orders scatter the same requests across
+    different physical pages — the outputs (including seeded sampling)
+    must not change."""
+    from apex_tpu.serving.cache import RESERVED_PAGES
+
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _mixed_requests()
+    usable = list(range(RESERVED_PAGES, 20))
+    a, _ = _run_paged(params, cfg, reqs, num_slots=2, num_pages=20)
+    b, _ = _run_paged(params, cfg, reqs, num_slots=2, num_pages=20,
+                      free_order=list(reversed(usable)))
+    assert a == b
+
+
+def test_paged_preemption_requeues_and_resumes():
+    """A pool too small for the full batch preempts a slot mid-decode
+    (pages released, request requeued WITH its progress); the resumed
+    request must finish with exactly the tokens an uncontended run
+    produces — preemption is a capacity event, never a numerics one."""
+    cfg = _cfg()
+    params = _params(cfg)
+    # two greedy requests, each individually fine (4 pages needed, 5
+    # usable) but over-committed together: both cross a page boundary
+    # at pos 8 and only one new page remains
+    reqs = [Request(prompt=(7, 11, 13, 17, 19), max_new_tokens=8),
+            Request(prompt=(23, 29, 31, 37, 41), max_new_tokens=8)]
+    roomy, _ = _run_paged(params, cfg, reqs, num_slots=2, num_pages=20)
+
+    engine = PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                               num_pages=7, page_size=4,
+                               buckets=(16, 32))
+    preempted = []
+    orig = engine.prepare_decode
+
+    def spy(positions):
+        out = orig(positions)
+        preempted.extend(out)
+        return out
+
+    engine.prepare_decode = spy
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    for r in reqs:
+        sched.submit(r)
+    tight = sched.run()
+    assert preempted  # the pool pressure actually bit
+    assert tight == roomy
+
+
+def test_paged_submit_validates_page_demand():
+    cfg = _cfg()
+    engine = PagedDecodeEngine(_params(cfg), cfg, num_slots=1,
+                               max_len=MAX_LEN, num_pages=5, page_size=4,
+                               buckets=(16, 32))
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    with pytest.raises(ValueError, match="pages"):
+        # 3 usable pages = 12 rows; 5 prompt + 8 new = 13 can't fit
+        sched.submit(Request(prompt=(2, 3, 5, 7, 11), max_new_tokens=8))
+    sched.submit(Request(prompt=(2, 3, 5, 7, 11), max_new_tokens=7))
+    outs = sched.run()
+    assert len(outs) == 1 and 1 <= len(outs[0]) <= 7
