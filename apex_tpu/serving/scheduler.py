@@ -192,8 +192,15 @@ class PagedDecodeEngine(DecodeEngine):
         private pages (shared ones are redirected to scratch; their
         rows were produced by the original request and are reused
         verbatim). Returns None when the pool can't cover the prompt
-        even after LRU eviction — the caller requeues."""
+        even after LRU eviction — the caller requeues. Raises for a
+        prompt beyond ``max_len`` BEFORE touching the pool (the
+        scheduler's submit check normally screens this, but the engine
+        must not leak page references when driven directly)."""
         toks = [int(t) for t in prompt]
+        if len(toks) > self.max_len:
+            raise ValueError(
+                f"prompt length {len(toks)} exceeds cache max_len "
+                f"{self.max_len}")
         n_pages = max_pages_per_slot(len(toks), self.page_size)
         keys = prefix_page_keys(toks, self.page_size)
         shared = self.pool.match_prefix(keys) if self.prefix_sharing \
@@ -226,9 +233,11 @@ class PagedDecodeEngine(DecodeEngine):
     def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
         """Before a decode tick writes row ``pos`` for each slot: cross
         a page boundary by allocating a fresh page, and clone (COW) a
-        shared page about to receive an appended row. A slot the pool
-        cannot serve even after LRU eviction is preempted — its pages
-        are released (often unblocking the rest of the batch) and the
+        shared page about to receive an appended row — unless the
+        failed clone alloc's registry eviction left the slot sole
+        owner, in which case the append proceeds in place. A slot the
+        pool genuinely cannot serve is preempted — its pages are
+        released (often unblocking the rest of the batch) and the
         caller requeues the request."""
         preempted: List[int] = []
         for i, pos in sorted(positions.items()):
@@ -246,6 +255,15 @@ class PagedDecodeEngine(DecodeEngine):
             elif self.pool.needs_copy(pages[idx]):      # COW
                 dst = self.pool.alloc()
                 if dst is None:
+                    # the failed alloc's LRU sweep emptied the prefix
+                    # registry; if the page's only co-owner was the
+                    # registry the append is now in-place legal — no
+                    # copy needed. Preempting instead would livelock:
+                    # re-admission recreates the exact same state
+                    # (registered partial last page at refcount 2,
+                    # pool at the validated worst-case fit)
+                    if not self.pool.needs_copy(pages[idx]):
+                        continue
                     self.free_slot(i)
                     preempted.append(i)
                     continue
@@ -357,7 +375,13 @@ class ContinuousBatchingScheduler:
         # original stream bit-for-bit)
         positions = {i: s.pos for i, s in enumerate(self._slots)
                      if s is not None}
-        for i in reversed(eng.prepare_decode(positions)):
+        # requeue in submission order: appendleft of the newest request
+        # first leaves the oldest at the queue front (slot-index order
+        # would let a later request resume before an earlier one)
+        preempted = eng.prepare_decode(positions)
+        for i in sorted(preempted,
+                        key=lambda j: self._slots[j].request_id,
+                        reverse=True):
             s = self._slots[i]
             self._queue.appendleft((s.request_id, s.request,
                                     list(s.generated)))

@@ -196,6 +196,78 @@ def test_paged_preemption_requeues_and_resumes():
     assert tight == roomy
 
 
+def test_paged_cow_exact_fit_pool_completes():
+    """Regression (livelock): with prefix sharing on, prefill
+    registers the prompt's partial last page (refcount 2), so the
+    first decode append wants a COW page — transiently one MORE page
+    than submit validated. With usable pages == the validated need the
+    clone alloc fails; the old code preempted, and re-admission
+    recreated the identical state, spinning run() forever. The failed
+    alloc's LRU sweep already dropped the registry's reference, so the
+    append is in-place legal and the run must finish with exactly the
+    uncontended tokens."""
+    from apex_tpu.serving.cache import RESERVED_PAGES
+
+    cfg = _cfg()
+    params = _params(cfg)
+    # 5-token prompt + 3 new = 8 rows = exactly 2 pages of 4
+    req = Request(prompt=(7, 11, 13, 17, 19), max_new_tokens=3)
+    roomy, _ = _run_paged(params, cfg, [req], num_slots=1, num_pages=20)
+
+    engine = PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                               num_pages=2 + RESERVED_PAGES, page_size=4,
+                               buckets=(16, 32))
+    prefills = 0
+    orig = engine.prefill
+
+    def spy(slot, prompt):
+        nonlocal prefills
+        prefills += 1
+        assert prefills < 10, "re-prefilling forever — COW livelock"
+        return orig(slot, prompt)
+
+    engine.prefill = spy
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    sched.submit(req)
+    assert sched.run() == roomy
+
+
+def test_preempted_slots_requeue_in_submission_order():
+    """Several slots preempted in one tick must rejoin the queue front
+    in submission order, not slot-index order (FIFO fairness)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    engine = PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                               num_pages=20, page_size=4,
+                               buckets=(16, 32))
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    # request 0 finishes on its prefill logits, freeing slot 0 for
+    # request 2 — leaving the LATER request in the LOWER slot
+    sched.submit(Request(prompt=(3, 5), max_new_tokens=1))
+    sched.submit(Request(prompt=(7, 11), max_new_tokens=8))
+    sched._admit()
+    sched.submit(Request(prompt=(13, 17), max_new_tokens=8))
+    sched._admit()
+    assert [s.request_id for s in sched._slots] == [2, 1]
+    engine.prepare_decode = lambda positions: list(positions)
+    sched._tick()
+    assert [rid for rid, _, _ in sched._queue] == [1, 2]
+
+
+def test_paged_prefill_rejects_oversized_prompt():
+    """Engine-level guard: prefill driven directly (without the
+    scheduler's submit check) must reject a prompt beyond max_len with
+    a clear error, before any page references are taken."""
+    cfg = _cfg()
+    params = _params(cfg)
+    engine = PagedDecodeEngine(params, cfg, num_slots=1, max_len=8,
+                               num_pages=20, page_size=4, buckets=(4, 8))
+    free_before = engine.pool.num_free
+    with pytest.raises(ValueError, match="max_len"):
+        engine.prefill(0, tuple(range(2, 11)))
+    assert engine.pool.num_free == free_before  # nothing leaked
+
+
 def test_paged_submit_validates_page_demand():
     cfg = _cfg()
     engine = PagedDecodeEngine(_params(cfg), cfg, num_slots=1,
