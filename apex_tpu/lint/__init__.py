@@ -46,8 +46,9 @@ CODES = {
               "by cast_args() nor declared in UNWIRED",
     "APX304": "op declared UNWIRED is actually intercepted by "
               "cast_args() (stale exemption)",
-    "APX401": "host-state read (time.*, np.random.*, random.*) in a "
-              "function reachable from a jit/custom_vjp/kernel body",
+    "APX401": "host-state read (time.*, np.random.*, random.*, or the "
+              "registered serving fault/stats state) in a function "
+              "reachable from a jit/custom_vjp/kernel body",
     "APX402": "global-statement write in a function reachable from a "
               "jit/custom_vjp/kernel body",
     "APX501": "traced program accumulates (reduce_sum/cumsum/scan "

@@ -30,6 +30,16 @@ never mentions jit. :func:`check_files` collects such imported-name
 roots per file (via the importing module's ``from apex_tpu.x import
 name`` statements), maps each dotted module back to its file in the
 linted set, and seeds them into that file's reachability frontier.
+
+Beyond the stdlib host modules, apex_tpu's OWN host state is
+registered: ``serving.faults`` (fault schedules, call counters) and
+``serving.health`` (``ServingStats`` degradation counters) exist to be
+mutated between ticks, so reading them inside a traced body freezes a
+counter value into the compiled program — the canonical staleness bug
+this tier exists for. Any use of those modules' stateful classes — or
+of a module-level instance constructed from them — inside a reachable
+function is APX401 (see ``_HOST_STATE_MODULES``/``_HOST_STATE_SYMBOLS``
+and the ``apx401_hoststate_*`` fixtures).
 """
 
 import ast
@@ -47,6 +57,15 @@ _TRANSFORMS = {
 }
 _DECORATOR_ROOTS = {"custom_vjp", "custom_jvp", "jit", "checkpoint",
                     "remat"}
+
+#: apex_tpu modules whose contents are host state by design: their
+#: counters/schedules mutate between scheduler ticks, so a traced body
+#: reading them bakes one stale value into the compiled program.
+_HOST_STATE_MODULES = {"apex_tpu.serving.faults",
+                       "apex_tpu.serving.health"}
+#: The stateful classes those modules export (re-exported by
+#: ``apex_tpu.serving``); instances are mutated on the host every tick.
+_HOST_STATE_SYMBOLS = {"FaultInjector", "ServingStats"}
 
 
 def _host_modules(tree: ast.Module) -> Dict[str, str]:
@@ -66,6 +85,44 @@ def _host_modules(tree: ast.Module) -> Dict[str, str]:
                     if a.name == "random":
                         out[a.asname or "random"] = "numpy.random"
     return out
+
+
+def _host_state_names(tree: ast.Module) -> Dict[str, str]:
+    """Local alias -> origin for names bound to serving fault/health
+    host state: imports of the registered modules or their stateful
+    classes (from the defining module or the ``apex_tpu.serving``
+    re-export), plus module-level instances constructed from an
+    imported stateful class (``STATS = ServingStats()``)."""
+    names: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ImportFrom) and node.module
+                and not node.level):
+            continue
+        if node.module in _HOST_STATE_MODULES:
+            for a in node.names:
+                if a.name != "*":
+                    names[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        elif node.module.split(".")[0] == "apex_tpu":
+            for a in node.names:
+                full = f"{node.module}.{a.name}"
+                if full in _HOST_STATE_MODULES \
+                        or a.name in _HOST_STATE_SYMBOLS:
+                    names[a.asname or a.name] = full
+    if not names:
+        return names
+    for node in tree.body:  # module-level singletons only
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if isinstance(value, ast.Call) and call_name(value) in names:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names[t.id] = f"{names[call_name(value)]} instance"
+    return names
 
 
 def _function_table(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
@@ -196,6 +253,7 @@ def check_module(tree: ast.Module, path: str,
                  extra_roots: Iterable[str] = ()) -> List[Finding]:
     table = _function_table(tree)
     host = _host_modules(tree)
+    host_state = _host_state_names(tree)
     if not table:
         return []
     reachable = set()
@@ -222,6 +280,21 @@ def check_module(tree: ast.Module, path: str,
                         f"'{name}', which is reachable from a traced "
                         "body — trace-time global mutation is baked in "
                         "as a constant"))
+                continue
+            if host_state and isinstance(node, (ast.Attribute,
+                                                ast.Name)):
+                chain = attr_chain(node)
+                if chain and chain[0] in host_state \
+                        and node.lineno not in seen:
+                    seen.add(node.lineno)
+                    findings.append(Finding(
+                        "APX401", path, node.lineno,
+                        f"serving host state '{'.'.join(chain)}' "
+                        f"({host_state[chain[0]]}) inside '{name}', "
+                        "which is reachable from a traced body — fault "
+                        "schedules and ServingStats counters mutate "
+                        "between ticks; a traced read freezes one "
+                        "stale value into the compiled program"))
                 continue
             if not isinstance(node, ast.Call):
                 continue

@@ -18,12 +18,19 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
 - ``scheduler`` — fixed-slot continuous batching (admit/evict on EOS or
   max-len; jit recompiles only per prompt bucket, never per request),
   over either engine; the paged engine adds prefix sharing at admission
-  and preemption-by-requeue when the pool runs dry.
+  and preemption-by-requeue when the pool runs dry;
+- ``health``    — typed failure taxonomy (``PoolExhausted``,
+  ``NonFiniteLogits``, ``RetryBudgetExhausted``, ...), per-engine
+  ``ServingStats`` counters, and typed ``RequestOutcome`` records;
+- ``faults``    — deterministic fault injection: a seedable
+  ``FaultInjector`` consulted at named host-side sites, schedules a
+  pure function of (seed, site, call index) so chaos runs replay
+  bit-for-bit (``tests/L0/run_serving/test_faults.py``).
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
-    KVCache, PagedKVCache, cache_partition_specs, init_cache,
-    init_paged_cache, paged_cache_partition_specs,
+    KVCache, PagedKVCache, audit_block_tables, cache_partition_specs,
+    init_cache, init_paged_cache, paged_cache_partition_specs,
 )
 from apex_tpu.serving.decode import (  # noqa: F401
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
@@ -31,8 +38,16 @@ from apex_tpu.serving.decode import (  # noqa: F401
     make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
     make_tp_prefill_fn,
 )
+from apex_tpu.serving.faults import (  # noqa: F401
+    SITES, FaultInjector, InjectedFault, fault_draw,
+)
+from apex_tpu.serving.health import (  # noqa: F401
+    FINISH_REASONS, AdmissionRejected, DeadlineExceeded, LivelockError,
+    NonFiniteLogits, PoolExhausted, PoolInvariantError, RequestOutcome,
+    RetryBudgetExhausted, ServingError, ServingStats,
+)
 from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
-from apex_tpu.serving.sampling import sample_tokens  # noqa: F401
+from apex_tpu.serving.sampling import finite_rows, sample_tokens  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
 )

@@ -137,6 +137,42 @@ def init_paged_cache(cfg: GPTConfig, num_slots: int, max_len: int,
                         block_tables=bt)
 
 
+def audit_block_tables(block_tables, slot_pages) -> bool:
+    """Cross-check the DEVICE block tables against the HOST allocator's
+    per-slot page lists: row ``i`` must map exactly ``slot_pages[i]``
+    followed by a NULL/SCRATCH-parked tail. This is the device half of
+    the pool invariant audit (``PagePool.check_invariants`` covers the
+    host half); a divergence means a ``prepare_decode``/``free_slot``
+    path updated one side and not the other. Raises
+    :class:`~apex_tpu.serving.health.PoolInvariantError`."""
+    import numpy as np
+
+    from apex_tpu.serving.health import PoolInvariantError
+
+    bt = np.asarray(block_tables)
+    if bt.shape[0] != len(slot_pages):
+        raise PoolInvariantError(
+            f"block table has {bt.shape[0]} rows but the host tracks "
+            f"{len(slot_pages)} slots")
+    for i, pages in enumerate(slot_pages):
+        if len(pages) > bt.shape[1]:
+            raise PoolInvariantError(
+                f"slot {i}: host maps {len(pages)} pages but the table "
+                f"row holds {bt.shape[1]}")
+        mapped = bt[i, :len(pages)].tolist()
+        if mapped != list(pages):
+            raise PoolInvariantError(
+                f"slot {i}: device row maps {mapped}, host allocator "
+                f"says {list(pages)}")
+        tail = bt[i, len(pages):]
+        stray = tail[(tail != NULL_PAGE) & (tail != SCRATCH_PAGE)]
+        if stray.size:
+            raise PoolInvariantError(
+                f"slot {i}: unmapped tail holds live page ids "
+                f"{sorted(set(stray.tolist()))} (must be NULL/SCRATCH)")
+    return True
+
+
 def paged_cache_partition_specs(rules=None) -> PagedKVCache:
     """Same table-derived TP layout as :func:`cache_partition_specs`:
     the pool's head axis (still axis 2) shards over ``model``; lengths

@@ -1,0 +1,128 @@
+"""Deterministic fault injection for the serving engine.
+
+Chaos testing is only useful when a failing run can be replayed
+bit-for-bit. The :class:`FaultInjector` therefore owns NO random state:
+whether site ``s`` faults on its ``i``-th call is a pure function of
+``(seed, s, i)`` — a sha256 hash mapped to a uniform draw — so a fault
+schedule is fully determined by the seed and the (deterministic) order
+in which the scheduler visits the sites. Re-running the same request
+stream with the same seed replays the exact same faults, and a single
+``(site, index)`` can be pinned via ``schedule=`` for surgical
+regression tests.
+
+Sites (consulted through injected hooks — the jitted programs
+themselves are never perturbed, so donation/APX512 and the compiled
+executables stay fault-free):
+
+=================  ======================================================
+``pool_alloc``     ``PagePool.alloc`` reports exhaustion (returns None)
+                   without sweeping the LRU registry — a transient
+                   allocation refusal
+``cow_clone``      the copy-on-write clone allocation in
+                   ``PagedDecodeEngine.prepare_decode`` fails — the slot
+                   is preempted and requeued
+``prefill_exec``   ``prefill`` raises :class:`InjectedFault` before
+                   touching the cache (page references are rolled back
+                   first) — a simulated transient device failure
+``decode_exec``    one slot's decode logits row is overwritten with NaN
+                   AFTER the jitted step — exercises the scheduler's
+                   always-on non-finite quarantine path
+``sample``         one slot's sampled token is replaced with an
+                   out-of-vocabulary id — exercises token validation
+=================  ======================================================
+
+This module is host state (counters + schedules); reading it from
+inside a traced function would freeze the values at trace time.
+apxlint APX401 registers it accordingly (``apex_tpu/lint/hygiene.py``).
+"""
+
+import hashlib
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+#: The named fault sites, in the order the docs list them.
+SITES = ("pool_alloc", "cow_clone", "prefill_exec", "decode_exec",
+         "sample")
+
+
+class InjectedFault(RuntimeError):
+    """A simulated transient failure (site ``prefill_exec``). The
+    scheduler treats it exactly like a real device fault: charge the
+    retry budget, back off, try again."""
+
+    def __init__(self, site: str, index: int):
+        super().__init__(f"injected fault at {site}[{index}]")
+        self.site = site
+        self.index = index
+
+
+def fault_draw(seed: int, site: str, index: int) -> Tuple[float, int]:
+    """The pure schedule function: ``(u01, payload)`` for call
+    ``index`` at ``site`` under ``seed``. ``u01`` decides whether the
+    call faults (compare against the site's rate); ``payload`` is a
+    deterministic uint32 the caller may use to pick a victim slot."""
+    h = hashlib.sha256(f"{seed}:{site}:{index}".encode()).digest()
+    u01 = int.from_bytes(h[:8], "big") / 2.0**64
+    return u01, int.from_bytes(h[8:12], "big")
+
+
+class FaultInjector:
+    """Seedable per-site fault schedule (see module doc). With neither
+    ``rates`` nor ``schedule`` the injector is inert — the default
+    every engine carries, so production paths pay one dict lookup and
+    an integer increment per site visit.
+
+    ``rates`` maps site -> fault probability in [0, 1] (evaluated
+    against the pure hash draw, NOT a stateful RNG). ``schedule`` maps
+    site -> iterable of call indices that fault unconditionally —
+    the single-fault chaos tests pin exact (site, index) pairs with it.
+    """
+
+    def __init__(self, seed: int = 0,
+                 rates: Optional[Mapping[str, float]] = None,
+                 schedule: Optional[Mapping[str, Iterable[int]]] = None):
+        for name, table in (("rates", rates), ("schedule", schedule)):
+            unknown = set(table or ()) - set(SITES)
+            if unknown:
+                raise ValueError(
+                    f"{name} names unknown fault sites {sorted(unknown)}"
+                    f"; sites are {SITES}")
+        self.seed = seed
+        self.rates: Dict[str, float] = dict(rates or {})
+        self.schedule: Dict[str, frozenset] = {
+            site: frozenset(int(i) for i in ixs)
+            for site, ixs in (schedule or {}).items()}
+        self._calls: Dict[str, int] = {s: 0 for s in SITES}
+        self._fired: Dict[str, int] = {s: 0 for s in SITES}
+
+    @property
+    def armed(self) -> bool:
+        """True when any site can ever fault."""
+        return bool(self.rates or self.schedule)
+
+    def draw(self, site: str) -> Tuple[bool, int]:
+        """Advance ``site``'s call counter and return ``(fired,
+        payload)``. Pure replay: the outcome depends only on (seed,
+        site, call index)."""
+        index = self._calls[site]  # KeyError on unknown site is wanted
+        self._calls[site] = index + 1
+        if not self.armed:
+            return False, 0
+        u01, payload = fault_draw(self.seed, site, index)
+        fired = (index in self.schedule.get(site, ())
+                 or u01 < self.rates.get(site, 0.0))
+        if fired:
+            self._fired[site] += 1
+        return fired, payload
+
+    def fire(self, site: str) -> bool:
+        """``draw`` for callers that only need the fault bit."""
+        return self.draw(site)[0]
+
+    def calls(self, site: str) -> int:
+        """How many times ``site`` has been consulted."""
+        return self._calls[site]
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        """Faults actually fired, per site."""
+        return dict(self._fired)

@@ -1,0 +1,170 @@
+"""Typed failure taxonomy + runtime counters for the serving engine.
+
+Apex's signature robustness move is the dynamic loss scaler: overflow
+is an EXPECTED state — detect it, skip the step, back off, keep
+training. This module gives the serving stack the same discipline.
+Instead of ``None`` returns and bare ``RuntimeError``\\ s, every way a
+request can fail is a named exception the scheduler either *recovers
+from* (retry/requeue) or *reports* (a :class:`RequestOutcome` with a
+typed reason), and every degradation event increments a counter in
+:class:`ServingStats` so a chaos run — or a production dashboard — can
+see exactly how the engine bent instead of broke.
+
+Everything here is plain host-side Python: no jax imports, no device
+state, no clocks. Counters and exceptions must NEVER be consulted from
+inside a traced function (their values would be frozen into the
+compiled program at trace time) — apxlint APX401 registers this module
+as host state and flags any such read (see
+``apex_tpu/lint/hygiene.py``).
+
+Taxonomy (all subclass :class:`ServingError`):
+
+==========================  ===============================================
+:class:`PoolExhausted`      the page pool cannot cover an allocation even
+                            after LRU prefix eviction (transient: retried
+                            after evictions free pages)
+:class:`NonFiniteLogits`    a decode/prefill step produced NaN/Inf logits
+                            or an out-of-range sampled token; the slot is
+                            quarantined and the request retried
+:class:`RetryBudgetExhausted`  a request burned through its per-request
+                            retry budget; it terminates with the tokens
+                            committed so far
+:class:`DeadlineExceeded`   a request overran its ``deadline_ticks``
+                            budget (scheduler ticks, deterministic — no
+                            wall clocks)
+:class:`AdmissionRejected`  backpressure: the bounded admission queue is
+                            full at ``submit()``
+:class:`LivelockError`      the scheduler's progress watchdog fired —
+                            carries the stuck request set and a pool
+                            snapshot instead of spinning forever
+:class:`PoolInvariantError` the runtime audit
+                            (``PagePool.check_invariants``) found the
+                            allocator's books inconsistent
+==========================  ===============================================
+"""
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+#: ``RequestOutcome.reason`` values — the full set of ways a request
+#: terminates. Healthy: ``eos`` / ``length`` / ``cache_full``; degraded
+#: (``error`` carries the typed exception): ``retry_budget`` /
+#: ``deadline``.
+FINISH_REASONS = ("eos", "length", "cache_full", "retry_budget",
+                  "deadline")
+
+
+class ServingError(RuntimeError):
+    """Base of the serving failure taxonomy."""
+
+
+class PoolExhausted(ServingError):
+    """The page pool cannot cover an allocation even after LRU prefix
+    eviction. Transient under load: evictions free pages and the
+    scheduler retries the admission."""
+
+    def __init__(self, msg: str, *, need: int = 0, free: int = 0,
+                 cached: int = 0):
+        super().__init__(msg)
+        self.need = need
+        self.free = free
+        self.cached = cached
+
+
+class NonFiniteLogits(ServingError):
+    """A decode/prefill step produced NaN/Inf logits (or the sampler
+    returned a token outside the vocabulary) for a slot. The slot is
+    quarantined: freed, its request requeued at the front — the retry
+    re-prefills from committed tokens, so the recovered stream is
+    bit-identical to the fault-free one."""
+
+
+class RetryBudgetExhausted(ServingError):
+    """A request consumed its whole retry budget; it terminates with a
+    ``retry_budget`` outcome carrying the tokens committed so far."""
+
+    def __init__(self, msg: str, *, request_id: int = -1,
+                 retries: int = 0):
+        super().__init__(msg)
+        self.request_id = request_id
+        self.retries = retries
+
+
+class DeadlineExceeded(ServingError):
+    """A request overran its ``deadline_ticks`` budget. Deadlines are
+    measured in scheduler ticks since submission — deterministic, so
+    chaos runs replay bit-for-bit (a wall-clock deadline would not)."""
+
+
+class AdmissionRejected(ServingError):
+    """Backpressure: ``submit()`` refused a request because the bounded
+    admission queue is full. The caller sheds load instead of growing
+    an unbounded queue."""
+
+
+class LivelockError(ServingError):
+    """The scheduler made no progress (no token, no completion, no
+    retry consumed) for ``watchdog_limit`` consecutive ticks. Carries
+    the stuck request set and a pool snapshot — the diagnostic the
+    PR-8 COW livelock needed, raised instead of spinning."""
+
+    def __init__(self, msg: str, *, stuck: Optional[Dict] = None,
+                 pool: Optional[Dict] = None):
+        super().__init__(msg)
+        self.stuck = stuck or {}
+        self.pool = pool or {}
+
+
+class PoolInvariantError(ServingError):
+    """The page allocator's books are inconsistent (refcounts vs. free
+    list vs. prefix registry vs. block tables) — raised by the runtime
+    audit, ``PagePool.check_invariants``."""
+
+
+@dataclasses.dataclass
+class ServingStats:
+    """Degradation counters, shared by an engine and its scheduler.
+    Pure host-side ints (never read these inside a traced function —
+    APX401). ``bench.py gpt_decode`` emits the non-zero subset so the
+    driver tracks degradation behavior across rounds."""
+
+    admission_rejections: int = 0  # submit() refused: queue full
+    pool_exhausted: int = 0        # admissions parked waiting for pages
+    preemptions: int = 0           # slots requeued on page pressure
+    cow_copies: int = 0            # shared pages cloned before append
+    retries: int = 0               # fault-path requeues (budgeted)
+    nan_events: int = 0            # non-finite logits quarantines
+    bad_samples: int = 0           # out-of-vocab sampled tokens
+    deadline_expired: int = 0      # requests cut at deadline_ticks
+    evictions: int = 0             # healthy completions freeing a slot
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestOutcome:
+    """How one request ended: its committed token stream plus a typed
+    reason (one of :data:`FINISH_REASONS`). Degraded terminations carry
+    the :class:`ServingError` that ended them in ``error``; for those,
+    ``tokens`` is a prefix of the fault-free stream (quarantine never
+    commits a corrupt token)."""
+
+    tokens: Tuple[int, ...]
+    reason: str
+    error: Optional[ServingError] = None
+    retries: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+def snapshot(obj: Any) -> Dict:
+    """Best-effort plain-dict view of a stats/outcome object for error
+    payloads and bench ``extra`` blocks."""
+    if hasattr(obj, "as_dict"):
+        return obj.as_dict()
+    if dataclasses.is_dataclass(obj):
+        return dataclasses.asdict(obj)
+    return dict(obj)

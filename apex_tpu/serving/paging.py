@@ -29,16 +29,25 @@ logical position lives in happens here, on the host, in plain Python:
   refs) until a page frees or the registry is empty; only then does it
   return ``None`` and the engine preempts.
 
+- **audit** — ``check_invariants()`` cross-checks refcounts against
+  the free list, the prefix registry, and (given the engine's per-slot
+  page lists) the slots' references; the chaos tier runs it after
+  every scheduler tick. The ``pool_alloc`` fault site
+  (``serving.faults``) hooks ``alloc()`` to simulate transient
+  exhaustion deterministically.
+
 Determinism: nothing here touches device state or RNG — identical
 request streams replay identical page decisions, and the decode math
 is placement-invariant anyway (see ``_paged_decode_attention``).
 """
 
 import hashlib
-from collections import OrderedDict, deque
+from collections import Counter, OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.serving.cache import RESERVED_PAGES
+from apex_tpu.serving.faults import FaultInjector
+from apex_tpu.serving.health import PoolInvariantError
 
 
 def prefix_page_keys(tokens: Sequence[int],
@@ -64,7 +73,8 @@ class PagePool:
     permuted orders and require identical logits."""
 
     def __init__(self, num_pages: int, page_size: int,
-                 free_order: Optional[Sequence[int]] = None):
+                 free_order: Optional[Sequence[int]] = None,
+                 injector: Optional[FaultInjector] = None):
         if page_size < 1:
             raise ValueError(f"page_size must be positive, got {page_size}")
         if num_pages <= RESERVED_PAGES:
@@ -80,6 +90,9 @@ class PagePool:
             raise ValueError(
                 f"free_order must be a permutation of {usable}")
         self._free = deque(free_order)
+        # fault hook: the ``pool_alloc`` site makes alloc() report a
+        # transient exhaustion (no LRU sweep, nothing evicted)
+        self.injector = injector or FaultInjector()
         self._ref: Dict[int, int] = {}  # page -> refcount; absent = free
         # chained prefix key -> page holding that page's rows; each
         # entry owns one reference on its page; insertion order = LRU
@@ -105,7 +118,11 @@ class PagePool:
 
     def alloc(self) -> Optional[int]:
         """An exclusively-owned page (refcount 1), evicting LRU prefix
-        entries as needed; None when genuinely out of pages."""
+        entries as needed; None when genuinely out of pages (or when
+        the ``pool_alloc`` fault site fires — a transient refusal that
+        leaves the registry untouched)."""
+        if self.injector.fire("pool_alloc"):
+            return None
         while not self._free and self._prefix:
             key, page = self._prefix.popitem(last=False)
             self.release(page)
@@ -168,3 +185,81 @@ class PagePool:
             return False
         self.release(page)
         return True
+
+    # -- runtime audit ----------------------------------------------------
+
+    def check_invariants(self, slot_pages: Optional[
+            Sequence[Sequence[int]]] = None) -> bool:
+        """Audit the allocator's books; raises
+        :class:`~apex_tpu.serving.health.PoolInvariantError` on the
+        first inconsistency, returns True when they balance. Checks:
+
+        - the free list is duplicate-free, within the usable id range,
+          and disjoint from the refcounted set;
+        - free + refcounted partition the usable pages exactly (a page
+          in neither is leaked, reserved ids appear in neither);
+        - every refcount is positive and covers the registry's own
+          reference on each cached page;
+        - with ``slot_pages`` (the engine's per-slot page lists): every
+          page's refcount equals its slot references plus its registry
+          entries — the exact accounting whose violation produced the
+          PR-8 COW livelock.
+
+        The chaos tier runs this after every scheduler tick
+        (``ContinuousBatchingScheduler(audit=True)``)."""
+        free = list(self._free)
+        usable = set(range(RESERVED_PAGES, self.num_pages))
+        if len(set(free)) != len(free):
+            raise PoolInvariantError(
+                f"free list holds duplicates: {sorted(free)}")
+        if not set(free) <= usable:
+            raise PoolInvariantError(
+                f"free list holds reserved/out-of-range ids: "
+                f"{sorted(set(free) - usable)}")
+        held = set(self._ref)
+        if held & set(free):
+            raise PoolInvariantError(
+                f"pages both free and refcounted: "
+                f"{sorted(held & set(free))}")
+        if not held <= usable:
+            raise PoolInvariantError(
+                f"refcounted reserved/out-of-range ids: "
+                f"{sorted(held - usable)}")
+        leaked = usable - held - set(free)
+        if leaked:
+            raise PoolInvariantError(
+                f"pages neither free nor referenced (leaked): "
+                f"{sorted(leaked)}")
+        bad = {p: r for p, r in self._ref.items() if r <= 0}
+        if bad:
+            raise PoolInvariantError(f"non-positive refcounts: {bad}")
+        registry = Counter(self._prefix.values())
+        for page, n in registry.items():
+            if self._ref.get(page, 0) < n:
+                raise PoolInvariantError(
+                    f"page {page}: {n} registry entries but refcount "
+                    f"{self._ref.get(page, 0)}")
+        if slot_pages is not None:
+            expected = Counter(registry)
+            for slot, pages in enumerate(slot_pages):
+                stray = [p for p in pages if p not in usable]
+                if stray:
+                    raise PoolInvariantError(
+                        f"slot {slot} maps reserved/out-of-range pages "
+                        f"{stray}")
+                expected.update(pages)
+            if dict(expected) != self._ref:
+                diff = {p: (expected.get(p, 0), self._ref.get(p, 0))
+                        for p in set(expected) | set(self._ref)
+                        if expected.get(p, 0) != self._ref.get(p, 0)}
+                raise PoolInvariantError(
+                    "refcounts out of balance (page: expected slot+"
+                    f"registry refs vs actual): {diff}")
+        return True
+
+    def snapshot(self) -> Dict:
+        """Plain-dict view of the allocator state for diagnostics
+        (:class:`~apex_tpu.serving.health.LivelockError` payloads)."""
+        return {"num_free": self.num_free,
+                "num_cached": self.num_cached,
+                "refcounts": dict(self._ref)}

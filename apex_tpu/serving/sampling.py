@@ -32,3 +32,13 @@ def sample_tokens(logits: jax.Array, keys: jax.Array,
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(
         jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def finite_rows(logits: jax.Array) -> jax.Array:
+    """(B,) bool — True where a row of ``logits`` is entirely finite.
+    The scheduler's always-on NaN/Inf quarantine gate: a device-side
+    reduction so each tick ships B bools to the host instead of the
+    (B, V) logits matrix. A False row is never sampled into a stream —
+    the slot is quarantined and the request retried
+    (``serving.health.NonFiniteLogits``)."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)

@@ -15,6 +15,47 @@ Determinism: every sampled token draws from
 request stream regenerates identical outputs regardless of how requests
 interleave across slots.
 
+Failure is an expected state (the dynamic-loss-scaler discipline,
+applied to serving — see ``serving.health``): pool exhaustion, NaN
+logits, bad samples, and transient exec faults all degrade gracefully
+instead of crashing or spinning:
+
+- **typed taxonomy** — ``PagedDecodeEngine.prefill`` raises
+  :class:`~apex_tpu.serving.health.PoolExhausted` instead of returning
+  ``None`` (``try_prefill`` keeps the None shim for direct drivers);
+  every request ends in a :class:`~apex_tpu.serving.health.\
+RequestOutcome` with a typed reason, in ``scheduler.outcomes``.
+- **quarantine + retry budget** — non-finite logits or an
+  out-of-vocabulary sampled token quarantines the slot: the corrupt
+  token is never committed, the slot is freed and the request requeued
+  at the queue FRONT with its progress. Because resume re-prefills the
+  committed tokens and keys depend only on ``(seed, n_generated)``,
+  the recovered stream is bit-identical to the fault-free one — and
+  co-tenant slots never notice. Each fault-path requeue charges the
+  request's retry budget (``max_retries``); exhaustion terminates it
+  with ``RetryBudgetExhausted``. Capacity preemptions stay free: they
+  consume no budget (pressure is not the request's fault).
+- **backpressure** — ``max_queue`` bounds the admission queue;
+  ``submit`` sheds load with ``AdmissionRejected`` beyond it.
+- **deadlines** — ``Request.deadline_ticks`` bounds a request's
+  lifetime in scheduler ticks (deterministic, unlike wall clocks);
+  overruns terminate with ``DeadlineExceeded`` and partial tokens.
+- **watchdog** — ``run()`` raises a diagnostic
+  :class:`~apex_tpu.serving.health.LivelockError` (stuck requests +
+  pool snapshot) after ``watchdog_limit`` ticks without progress,
+  instead of spinning (the PR-8 COW livelock, generalized). Progress
+  is strictly monotonic evidence of convergence: a token committed, a
+  request terminated, or a (finite) retry consumed — capacity
+  preemptions deliberately do NOT count.
+- **audit** — ``audit=True`` runs the engine's pool-invariant checker
+  after every tick (the chaos tier's setting).
+
+Fault injection (``serving.faults``) drives all of these paths
+deterministically: the engines consult their
+:class:`~apex_tpu.serving.faults.FaultInjector` at the named sites
+through host-side hooks, so the jitted programs — and a replayed chaos
+run — stay bit-exact.
+
 The engine's cache is DONATED to each jitted step (see
 ``serving.decode``); ``DecodeEngine`` immediately rebinds
 ``self.cache``, so never hold a stale reference to it across a step.
@@ -30,15 +71,20 @@ import numpy as np
 
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.serving.cache import (
-    NULL_PAGE, RESERVED_PAGES, SCRATCH_PAGE, init_cache,
-    init_paged_cache, max_pages_per_slot,
+    NULL_PAGE, RESERVED_PAGES, SCRATCH_PAGE, audit_block_tables,
+    init_cache, init_paged_cache, max_pages_per_slot,
 )
 from apex_tpu.serving.decode import (
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
     make_paged_prefill_fn, make_prefill_fn,
 )
+from apex_tpu.serving.faults import FaultInjector, InjectedFault
+from apex_tpu.serving.health import (
+    AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
+    PoolExhausted, RequestOutcome, RetryBudgetExhausted, ServingStats,
+)
 from apex_tpu.serving.paging import PagePool, prefix_page_keys
-from apex_tpu.serving.sampling import sample_tokens
+from apex_tpu.serving.sampling import finite_rows, sample_tokens
 from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
 
 
@@ -46,11 +92,15 @@ from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
 class Request:
     """One generation request. ``temperature <= 0`` means greedy;
     ``seed`` roots this request's PRNG stream (independent of slot
-    placement and co-tenants)."""
+    placement and co-tenants). ``deadline_ticks``, when set, bounds the
+    request's lifetime in scheduler ticks since submission — a
+    deterministic deadline (overruns end in a ``deadline`` outcome with
+    the tokens committed so far)."""
     prompt: Tuple[int, ...]
     max_new_tokens: int = 16
     temperature: float = 0.0
     seed: int = 0
+    deadline_ticks: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -65,14 +115,18 @@ class _Slot:
 class DecodeEngine:
     """Owns the params, the cache, and the three jitted programs
     (bucketed prefill, batched decode, sampling). ``top_k`` is static —
-    an engine setting, compiled into the sampler."""
+    an engine setting, compiled into the sampler. ``injector`` hooks
+    the fault sites (inert by default); ``stats`` is the
+    :class:`~apex_tpu.serving.health.ServingStats` counter block the
+    scheduler shares."""
 
     paged = False
 
     def __init__(self, params, cfg: GPTConfig, num_slots: int,
                  max_len: int, cache_dtype=jnp.bfloat16, top_k: int = 0,
                  buckets: Optional[Sequence[int]] = None,
-                 compute_dtype=None):
+                 compute_dtype=None,
+                 injector: Optional[FaultInjector] = None):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -84,31 +138,72 @@ class DecodeEngine:
         self.buckets = tuple(sorted({min(int(b), max_len)
                                      for b in buckets}))
         self.top_k = top_k
+        self.injector = injector or FaultInjector()
+        self.stats = ServingStats()
         self.cache = init_cache(cfg, num_slots, max_len, cache_dtype)
         self._prefill = make_prefill_fn(cfg, compute_dtype)
         self._decode = make_decode_fn(cfg, compute_dtype)
         self._sample = jax.jit(sample_tokens, static_argnames="top_k")
+        self._finite = jax.jit(finite_rows)
 
-    def prefill(self, slot: int,
-                prompt: Sequence[int]) -> Optional[jax.Array]:
+    def prefill(self, slot: int, prompt: Sequence[int]) -> jax.Array:
         """Run the full forward over ``prompt`` into cache row ``slot``;
-        returns the last-real-token logits (1, V). (The paged engine
-        may instead return None — out of pages, admission must wait.)"""
+        returns the last-real-token logits (1, V). Raises
+        :class:`~apex_tpu.serving.health.PoolExhausted` when capacity
+        can't cover the prompt (paged engine) and
+        :class:`~apex_tpu.serving.faults.InjectedFault` under an armed
+        ``prefill_exec`` fault site — both with all transient resources
+        rolled back."""
+        fired, _ = self.injector.draw("prefill_exec")
+        if fired:
+            raise InjectedFault("prefill_exec",
+                                self.injector.calls("prefill_exec") - 1)
         ids = np.asarray(prompt, np.int32)[None, :]
         ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
         self.cache, logits = self._prefill(
             self.params, self.cache, ids, mask, jnp.int32(slot))
         return logits
 
+    def try_prefill(self, slot: int,
+                    prompt: Sequence[int]) -> Optional[jax.Array]:
+        """Compat shim for direct drivers predating the typed taxonomy:
+        ``None`` on :class:`PoolExhausted` instead of the raise. New
+        code should call :meth:`prefill` and catch the typed error."""
+        try:
+            return self.prefill(slot, prompt)
+        except PoolExhausted:
+            return None
+
     def decode(self, tokens: jax.Array, active: jax.Array) -> jax.Array:
         """One token for every slot; ``active`` gates length advance.
-        Returns (num_slots, V) fp32 logits."""
+        Returns (num_slots, V) fp32 logits. An armed ``decode_exec``
+        fault site overwrites one deterministic victim row with NaN
+        AFTER the jitted step — the compiled program and the other
+        rows stay bit-exact, and the scheduler's finiteness gate
+        (:func:`~apex_tpu.serving.sampling.finite_rows`) must catch
+        it."""
         self.cache, logits = self._decode(self.params, self.cache,
                                           tokens, active)
+        fired, payload = self.injector.draw("decode_exec")
+        if fired:
+            victim = int(payload % logits.shape[0])
+            logits = logits.at[victim].set(jnp.nan)
         return logits
 
     def sample(self, logits, keys, temperature) -> jax.Array:
-        return self._sample(logits, keys, temperature, top_k=self.top_k)
+        toks = self._sample(logits, keys, temperature, top_k=self.top_k)
+        fired, payload = self.injector.draw("sample")
+        if fired:
+            # out-of-vocabulary id: negative, so it can never collide
+            # with a real token — the scheduler's range check quarantines
+            victim = int(payload % toks.shape[0])
+            toks = toks.at[victim].set(jnp.int32(-1 - payload % 7))
+        return toks
+
+    def finite(self, logits) -> jax.Array:
+        """(B,) bool device reduction: which logits rows are safe to
+        sample (see :func:`~apex_tpu.serving.sampling.finite_rows`)."""
+        return self._finite(logits)
 
     # scheduler hooks, no-ops for the dense engine: a cache row needs
     # no per-token capacity and frees by being overwritten
@@ -122,6 +217,15 @@ class DecodeEngine:
 
     def free_slot(self, slot: int) -> None:
         """Release slot-owned resources on eviction/preemption."""
+
+    def check_invariants(self) -> bool:
+        """Audit engine-owned bookkeeping (pool refcounts, block
+        tables); trivially true for the dense cache."""
+        return True
+
+    def pool_snapshot(self) -> Dict:
+        """Allocator state for diagnostics (LivelockError payloads)."""
+        return {}
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -148,7 +252,8 @@ class PagedDecodeEngine(DecodeEngine):
                  buckets: Optional[Sequence[int]] = None,
                  compute_dtype=None,
                  free_order: Optional[Sequence[int]] = None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: bool = True,
+                 injector: Optional[FaultInjector] = None):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -166,14 +271,18 @@ class PagedDecodeEngine(DecodeEngine):
                 f"paged prefill writes whole pages: buckets {bad} are "
                 f"not multiples of page_size {page_size}")
         self.top_k = top_k
+        self.injector = injector or FaultInjector()
+        self.stats = ServingStats()
         self.cache = init_paged_cache(cfg, num_slots, max_len, num_pages,
                                       page_size, cache_dtype)
-        self.pool = PagePool(num_pages, page_size, free_order)
+        self.pool = PagePool(num_pages, page_size, free_order,
+                             injector=self.injector)
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
         self._prefill = make_paged_prefill_fn(cfg, compute_dtype)
         self._decode = make_paged_decode_fn(cfg, compute_dtype)
         self._copy = make_copy_page_fn()
         self._sample = jax.jit(sample_tokens, static_argnames="top_k")
+        self._finite = jax.jit(finite_rows)
 
     def page_demand(self, total_len: int) -> None:
         need = max_pages_per_slot(min(total_len, self.max_len),
@@ -184,18 +293,21 @@ class PagedDecodeEngine(DecodeEngine):
                 f"request needs up to {need} pages but the pool only "
                 f"has {usable} usable pages")
 
-    def prefill(self, slot: int,
-                prompt: Sequence[int]) -> Optional[jax.Array]:
+    def prefill(self, slot: int, prompt: Sequence[int]) -> jax.Array:
         """Admit ``prompt`` into ``slot``: share the longest cached
-        prefix run, allocate private pages for the rest, register the
-        chain for future requests, and prefill — writing ONLY the
-        private pages (shared ones are redirected to scratch; their
-        rows were produced by the original request and are reused
-        verbatim). Returns None when the pool can't cover the prompt
-        even after LRU eviction — the caller requeues. Raises for a
-        prompt beyond ``max_len`` BEFORE touching the pool (the
-        scheduler's submit check normally screens this, but the engine
-        must not leak page references when driven directly)."""
+        prefix run, allocate private pages for the rest, prefill —
+        writing ONLY the private pages (shared ones are redirected to
+        scratch; their rows were produced by the original request and
+        are reused verbatim) — and register the chain for future
+        requests. Raises :class:`PoolExhausted` when the pool can't
+        cover the prompt even after LRU eviction, and
+        :class:`InjectedFault` under an armed ``prefill_exec`` site;
+        BOTH release every transient page reference first, so the
+        caller can simply requeue (``check_invariants`` audits this
+        rollback). Raises ``ValueError`` for a prompt beyond
+        ``max_len`` BEFORE touching the pool (the scheduler's submit
+        check normally screens this, but the engine must not leak page
+        references when driven directly)."""
         toks = [int(t) for t in prompt]
         if len(toks) > self.max_len:
             raise ValueError(
@@ -211,11 +323,19 @@ class PagedDecodeEngine(DecodeEngine):
             if p is None:
                 for q in shared + private:
                     self.pool.release(q)
-                return None
+                raise PoolExhausted(
+                    f"prompt needs {n_pages} pages; pool has "
+                    f"{self.pool.num_free} free and nothing left to "
+                    "evict", need=n_pages, free=self.pool.num_free,
+                    cached=self.pool.num_cached)
             private.append(p)
         pages = shared + private
-        if self.prefix_sharing:
-            self.pool.register_prefix(keys, pages)
+        fired, _ = self.injector.draw("prefill_exec")
+        if fired:
+            for q in pages:
+                self.pool.release(q)
+            raise InjectedFault("prefill_exec",
+                                self.injector.calls("prefill_exec") - 1)
         self._slot_pages[slot] = list(pages)
 
         ids = np.asarray(toks, np.int32)[None, :]
@@ -228,6 +348,8 @@ class PagedDecodeEngine(DecodeEngine):
         self.cache, logits = self._prefill(
             self.params, self.cache, ids, mask, jnp.int32(slot),
             jnp.asarray(write), jnp.asarray(row))
+        if self.prefix_sharing:
+            self.pool.register_prefix(keys, pages)
         return logits
 
     def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
@@ -236,9 +358,9 @@ class PagedDecodeEngine(DecodeEngine):
         shared page about to receive an appended row — unless the
         failed clone alloc's registry eviction left the slot sole
         owner, in which case the append proceeds in place. A slot the
-        pool genuinely cannot serve is preempted — its pages are
-        released (often unblocking the rest of the batch) and the
-        caller requeues the request."""
+        pool genuinely cannot serve (or whose ``cow_clone`` fault site
+        fired) is preempted — its pages are released (often unblocking
+        the rest of the batch) and the caller requeues the request."""
         preempted: List[int] = []
         for i, pos in sorted(positions.items()):
             pages = self._slot_pages[i]
@@ -246,14 +368,14 @@ class PagedDecodeEngine(DecodeEngine):
             if idx == len(pages):                       # page boundary
                 p = self.pool.alloc()
                 if p is None:
-                    self.free_slot(i)
-                    preempted.append(i)
+                    self._preempt(i, preempted)
                     continue
                 pages.append(p)
                 self.cache = self.cache._replace(
                     block_tables=self.cache.block_tables.at[i, idx].set(p))
             elif self.pool.needs_copy(pages[idx]):      # COW
-                dst = self.pool.alloc()
+                dst = None if self.injector.fire("cow_clone") \
+                    else self.pool.alloc()
                 if dst is None:
                     # the failed alloc's LRU sweep emptied the prefix
                     # registry; if the page's only co-owner was the
@@ -264,9 +386,9 @@ class PagedDecodeEngine(DecodeEngine):
                     # pool at the validated worst-case fit)
                     if not self.pool.needs_copy(pages[idx]):
                         continue
-                    self.free_slot(i)
-                    preempted.append(i)
+                    self._preempt(i, preempted)
                     continue
+                self.stats.cow_copies += 1
                 self.cache = self._copy(self.cache,
                                         jnp.int32(pages[idx]),
                                         jnp.int32(dst))
@@ -276,6 +398,11 @@ class PagedDecodeEngine(DecodeEngine):
                 self.pool.release(pages[idx])
                 pages[idx] = dst
         return preempted
+
+    def _preempt(self, slot: int, preempted: List[int]) -> None:
+        self.free_slot(slot)
+        self.stats.preemptions += 1
+        preempted.append(slot)
 
     def free_slot(self, slot: int) -> None:
         """Release the slot's page references and park its block-table
@@ -288,19 +415,56 @@ class PagedDecodeEngine(DecodeEngine):
             block_tables=self.cache.block_tables.at[slot].set(
                 jnp.full((self.max_pages,), SCRATCH_PAGE, jnp.int32)))
 
+    def check_invariants(self) -> bool:
+        """Full pool audit: host-side refcount/free-list/registry
+        accounting against the per-slot page lists
+        (:meth:`PagePool.check_invariants`), then the device block
+        tables against those same lists
+        (:func:`~apex_tpu.serving.cache.audit_block_tables`). Raises
+        :class:`~apex_tpu.serving.health.PoolInvariantError`."""
+        self.pool.check_invariants(self._slot_pages)
+        audit_block_tables(self.cache.block_tables, self._slot_pages)
+        return True
+
+    def pool_snapshot(self) -> Dict:
+        snap = self.pool.snapshot()
+        snap["slot_pages"] = [list(p) for p in self._slot_pages]
+        return snap
+
 
 class ContinuousBatchingScheduler:
-    """FIFO → fixed slots → batched decode ticks (see module doc)."""
+    """FIFO → fixed slots → batched decode ticks, with the
+    graceful-degradation layer (see module doc): typed outcomes in
+    ``self.outcomes``, shared ``self.stats`` counters, per-request
+    retry budgets, deterministic deadlines, bounded admission, a
+    progress watchdog, and an optional per-tick invariant audit."""
 
-    def __init__(self, engine: DecodeEngine, eos_id: int):
+    def __init__(self, engine: DecodeEngine, eos_id: int, *,
+                 max_retries: int = 3, max_queue: Optional[int] = None,
+                 watchdog_limit: int = 64, audit: bool = False):
         self.engine = engine
         self.eos_id = eos_id
+        self.max_retries = max_retries
+        self.max_queue = max_queue
+        self.watchdog_limit = watchdog_limit
+        self.audit = audit
+        self.stats = engine.stats  # one counter block per engine
+        self.outcomes: Dict[int, RequestOutcome] = {}
         self._queue: deque = deque()
         self._slots: List[Optional[_Slot]] = [None] * engine.num_slots
-        self._results: dict = {}
         self._next_id = 0
+        self._retries: Dict[int, int] = {}
+        self._submit_tick: Dict[int, int] = {}
+        self._tick_no = 0
+        self._tokens_emitted = 0
 
     def submit(self, request: Request) -> int:
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            self.stats.admission_rejections += 1
+            raise AdmissionRejected(
+                f"admission queue is at its bound ({self.max_queue}); "
+                "shed load and retry after completions")
         if not len(request.prompt):
             raise ValueError("empty prompt")
         if len(request.prompt) > self.engine.max_len:
@@ -315,14 +479,84 @@ class ContinuousBatchingScheduler:
             len(request.prompt) + request.max_new_tokens)
         rid = self._next_id
         self._next_id += 1
+        self._submit_tick[rid] = self._tick_no
         # third element: tokens already generated — empty for fresh
-        # submissions, carried through preemption-by-requeue
+        # submissions, carried through preemption/quarantine requeue
         self._queue.append((rid, request, []))
         return rid
 
     def _slot_key(self, slot: _Slot) -> jax.Array:
         return jax.random.fold_in(
             jax.random.PRNGKey(slot.request.seed), len(slot.generated))
+
+    # -- typed termination ------------------------------------------------
+
+    def _finish(self, rid: int, tokens: Sequence[int], reason: str,
+                error=None) -> None:
+        self.outcomes[rid] = RequestOutcome(
+            tuple(int(t) for t in tokens), reason, error,
+            retries=self._retries.get(rid, 0))
+
+    def _charge_retry(self, rid: int) -> bool:
+        """Consume one unit of ``rid``'s retry budget; True when the
+        budget is now exhausted (the caller must terminate it)."""
+        self.stats.retries += 1
+        n = self._retries.get(rid, 0) + 1
+        self._retries[rid] = n
+        return n > self.max_retries
+
+    def _budget_error(self, rid: int, cause) -> RetryBudgetExhausted:
+        return RetryBudgetExhausted(
+            f"request {rid}: retry budget ({self.max_retries}) "
+            f"exhausted; last fault: {cause}", request_id=rid,
+            retries=self._retries.get(rid, 0))
+
+    def _quarantine(self, i: int, err: NonFiniteLogits) -> None:
+        """Free a slot whose tick output was corrupt; retry the request
+        from its committed tokens (requeue at the FRONT — the resumed
+        stream is bit-identical to the uncontended one) or, with the
+        budget gone, terminate it typed."""
+        s = self._slots[i]
+        self._slots[i] = None
+        self.engine.free_slot(i)
+        rid = s.request_id
+        if self._charge_retry(rid):
+            self._finish(rid, s.generated, "retry_budget",
+                         self._budget_error(rid, err))
+        else:
+            self._queue.appendleft((rid, s.request, list(s.generated)))
+
+    def _expire_deadlines(self) -> None:
+        def expired(req: Request, rid: int) -> bool:
+            return (req.deadline_ticks is not None
+                    and self._tick_no - self._submit_tick.get(rid, 0)
+                    >= req.deadline_ticks)
+
+        if any(expired(req, rid) for rid, req, _ in self._queue):
+            keep: deque = deque()
+            for rid, req, resume in self._queue:
+                if expired(req, rid):
+                    self.stats.deadline_expired += 1
+                    self._finish(rid, resume, "deadline",
+                                 DeadlineExceeded(
+                                     f"request {rid}: queued past its "
+                                     f"{req.deadline_ticks}-tick "
+                                     "deadline"))
+                else:
+                    keep.append((rid, req, resume))
+            self._queue = keep
+        for i, s in enumerate(self._slots):
+            if s is not None and expired(s.request, s.request_id):
+                self.stats.deadline_expired += 1
+                self._slots[i] = None
+                self.engine.free_slot(i)
+                self._finish(s.request_id, s.generated, "deadline",
+                             DeadlineExceeded(
+                                 f"request {s.request_id}: exceeded its "
+                                 f"{s.request.deadline_ticks}-tick "
+                                 "deadline mid-decode"))
+
+    # -- admission / decode ticks -----------------------------------------
 
     def _admit(self) -> None:
         eng = self.engine
@@ -334,37 +568,94 @@ class ContinuousBatchingScheduler:
             # it had produced EXCEPT its last sampled token, which the
             # next decode tick feeds (the normal teacher-forcing shape)
             tokens = tuple(req.prompt) + tuple(resume[:-1])
-            logits = eng.prefill(i, tokens)
-            if logits is None:
-                # out of pages: keep FIFO order, wait for evictions
-                if all(s is None for s in self._slots):
-                    raise RuntimeError(
+            try:
+                logits = eng.prefill(i, tokens)
+            except PoolExhausted as e:
+                # out of pages: keep FIFO order, wait for evictions —
+                # unless the pool can't serve the head even with every
+                # slot free and no fault injection to blame, which is a
+                # submit-validation bug worth surfacing typed
+                self.stats.pool_exhausted += 1
+                if all(s is None for s in self._slots) \
+                        and not eng.injector.armed:
+                    raise PoolExhausted(
                         "page pool cannot admit the queue head even "
-                        "with every slot free — submit-time validation "
-                        "should have rejected it")
+                        f"with every slot free (request {rid}) — "
+                        "submit-time validation should have rejected "
+                        "it", need=e.need, free=e.free,
+                        cached=e.cached) from e
                 break
+            except InjectedFault as e:
+                # transient exec failure; the engine rolled back its
+                # page references, the request stays at the queue front
+                if self._charge_retry(rid):
+                    self._queue.popleft()
+                    self._finish(rid, resume, "retry_budget",
+                                 self._budget_error(rid, e))
+                    continue
+                break
+            first_tok = None
+            if not resume:
+                # the FIRST generated token comes from the prefill
+                # logits; on resume it already exists. Both gates below
+                # are the always-on production checks the decode tick
+                # also applies.
+                if not bool(np.asarray(eng.finite(logits)).all()):
+                    self.stats.nan_events += 1
+                    if self._fail_admission(i, rid, NonFiniteLogits(
+                            f"request {rid}: non-finite prefill "
+                            "logits")):
+                        continue
+                    break
+                key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+                first_tok = int(eng.sample(
+                    logits, key[None, :],
+                    jnp.asarray([req.temperature], jnp.float32))[0])
+                if not 0 <= first_tok < eng.cfg.vocab_size:
+                    self.stats.bad_samples += 1
+                    if self._fail_admission(i, rid, NonFiniteLogits(
+                            f"request {rid}: first sampled token "
+                            f"{first_tok} outside "
+                            f"[0, {eng.cfg.vocab_size})")):
+                        continue
+                    break
             self._queue.popleft()
             slot = _Slot(rid, req, len(req.prompt), list(resume),
                          len(tokens))
-            if not resume:
-                # the FIRST generated token comes from the prefill
-                # logits; on resume it already exists
-                tok = int(eng.sample(
-                    logits, self._slot_key(slot)[None, :],
-                    jnp.asarray([req.temperature], jnp.float32))[0])
-                slot.generated.append(tok)
+            if first_tok is not None:
+                slot.generated.append(first_tok)
+                self._tokens_emitted += 1
             self._slots[i] = slot
             self._maybe_evict(i)
 
+    def _fail_admission(self, i: int, rid: int, err) -> bool:
+        """Roll back a corrupt admission (slot freed, retry charged).
+        True when the request terminated (budget gone) — the caller
+        moves on; False when it should back off and retry later."""
+        self.engine.free_slot(i)
+        if self._charge_retry(rid):
+            self._queue.popleft()
+            # only fresh admissions sample a first token, so there are
+            # no committed tokens to carry into the outcome
+            self._finish(rid, (), "retry_budget",
+                         self._budget_error(rid, err))
+            return True
+        return False
+
     def _maybe_evict(self, i: int) -> None:
         slot = self._slots[i]
-        done = (slot.generated[-1] == self.eos_id
-                or len(slot.generated) >= slot.request.max_new_tokens
-                or slot.pos >= self.engine.max_len)  # cache row full
-        if done:
-            self._results[slot.request_id] = list(slot.generated)
-            self._slots[i] = None
-            self.engine.free_slot(i)
+        if slot.generated[-1] == self.eos_id:
+            reason = "eos"
+        elif len(slot.generated) >= slot.request.max_new_tokens:
+            reason = "length"
+        elif slot.pos >= self.engine.max_len:  # cache row full
+            reason = "cache_full"
+        else:
+            return
+        self.stats.evictions += 1
+        self._finish(slot.request_id, slot.generated, reason)
+        self._slots[i] = None
+        self.engine.free_slot(i)
 
     def _tick(self) -> None:
         eng = self.engine
@@ -400,18 +691,74 @@ class ContinuousBatchingScheduler:
             [self._slot_key(s) if s else jax.random.PRNGKey(0)
              for s in self._slots])
         logits = eng.decode(tokens, active)
+        finite = np.asarray(eng.finite(logits))
         next_tokens = np.asarray(eng.sample(logits, keys, temps))
+        vocab = eng.cfg.vocab_size
+        quarantined: List[Tuple[int, NonFiniteLogits]] = []
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
-            slot.generated.append(int(next_tokens[i]))
+            if not bool(finite[i]):
+                self.stats.nan_events += 1
+                quarantined.append((i, NonFiniteLogits(
+                    f"slot {i} (request {slot.request_id}): non-finite "
+                    "decode logits")))
+                continue
+            tok = int(next_tokens[i])
+            if not 0 <= tok < vocab:
+                self.stats.bad_samples += 1
+                quarantined.append((i, NonFiniteLogits(
+                    f"slot {i} (request {slot.request_id}): sampled "
+                    f"token {tok} outside [0, {vocab})")))
+                continue
+            slot.generated.append(tok)
             slot.pos += 1
+            self._tokens_emitted += 1
             self._maybe_evict(i)
+        # quarantine AFTER the healthy slots commit, requeueing at the
+        # front in submission order (same rule as preemption)
+        for i, err in sorted(
+                quarantined,
+                key=lambda t: self._slots[t[0]].request_id,
+                reverse=True):
+            self._quarantine(i, err)
+
+    # -- drive loop --------------------------------------------------------
+
+    def _raise_livelock(self, stalled: int) -> None:
+        stuck = {"queued": [rid for rid, _, _ in self._queue],
+                 "slots": {i: s.request_id
+                           for i, s in enumerate(self._slots)
+                           if s is not None}}
+        raise LivelockError(
+            f"no progress (token committed, request terminated, or "
+            f"retry consumed) in {stalled} consecutive scheduler "
+            f"ticks; stuck requests: queued={stuck['queued']} "
+            f"slots={stuck['slots']}; pool={self.engine.pool_snapshot()}",
+            stuck=stuck, pool=self.engine.pool_snapshot())
 
     def run(self) -> List[List[int]]:
         """Drain the queue; returns generated tokens (EOS included when
-        emitted) per request, in submission order."""
+        emitted) per request, in submission order. Typed outcomes —
+        including degraded terminations, whose token lists are a prefix
+        of their fault-free streams — live in ``self.outcomes``. Raises
+        :class:`LivelockError` after ``watchdog_limit`` consecutive
+        ticks without progress instead of spinning."""
+        stalled, last = 0, None
         while self._queue or any(s is not None for s in self._slots):
+            self._tick_no += 1
+            self._expire_deadlines()
             self._admit()
             self._tick()
-        return [self._results[rid] for rid in sorted(self._results)]
+            if self.audit:
+                self.engine.check_invariants()
+            snap = (self._tokens_emitted, len(self.outcomes),
+                    self.stats.retries)
+            if snap == last:
+                stalled += 1
+                if stalled >= self.watchdog_limit:
+                    self._raise_livelock(stalled)
+            else:
+                stalled, last = 0, snap
+        return [list(self.outcomes[rid].tokens)
+                for rid in sorted(self.outcomes)]

@@ -8,6 +8,12 @@
 #   ./run_tests.sh all      # both
 #   ./run_tests.sh quick    # fast high-signal subset (-m quick) for the
 #                           #     inner loop; full tier stays in CI
+#   ./run_tests.sh chaos    # deterministic fault-injection tier for the
+#                           #     serving engine (-m chaos): pinned and
+#                           #     randomized fault schedules, typed
+#                           #     outcomes, pool invariants audited
+#                           #     after every tick, bit-identity of
+#                           #     unaffected streams
 #   ./run_tests.sh gate     # L1 loss-curve gate: amp levels AND the
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
@@ -36,6 +42,7 @@ case "$tier" in
   L1)    exec python -m pytest tests/L1 -q "$@" ;;
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
+  chaos) exec python -m pytest tests -q -m chaos "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
   lint)  # combined AST + VMEM + trace + cost + sharding tiers, under a
          # wall-time budget: a slow lint gate stops being run, so

@@ -1,0 +1,19 @@
+# apxlint: fixture
+# Known-bad: apex_tpu's OWN registered host state — the serving fault
+# injector and ServingStats counters mutate between scheduler ticks, so
+# consulting either inside a jitted decode body freezes one stale value
+# into the compiled program. Both reads must raise APX401.
+import jax
+
+from apex_tpu.serving import ServingStats
+from apex_tpu.serving.faults import FaultInjector
+
+STATS = ServingStats()
+INJECTOR = FaultInjector(rates={"decode_exec": 0.01})
+
+
+@jax.jit
+def decode_body(logits):
+    if INJECTOR.fire("decode_exec"):
+        logits = logits * 0.0
+    return logits * (1.0 + STATS.nan_events)

@@ -1,0 +1,20 @@
+# apxlint: fixture
+# Known-clean: the same serving host state consulted from plain host
+# code (between ticks, not reachable from any traced root) is exactly
+# how the scheduler uses it — no findings.
+import jax
+
+from apex_tpu.serving import ServingStats
+from apex_tpu.serving.faults import FaultInjector
+
+STATS = ServingStats()
+INJECTOR = FaultInjector(rates={"decode_exec": 0.01})
+
+
+def host_tick_report():
+    return STATS.as_dict(), INJECTOR.counts
+
+
+@jax.jit
+def decode_body(logits):
+    return logits * 2.0

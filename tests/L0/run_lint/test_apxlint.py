@@ -63,6 +63,14 @@ def test_apx401_host_state_read():
     assert _codes("apx401_clean.py") == []
 
 
+def test_apx401_serving_host_state():
+    # apex_tpu's own registered host state: a FaultInjector consult and
+    # a ServingStats counter read inside a jitted decode body
+    codes = _codes("apx401_hoststate_bad.py")
+    assert codes.count("APX401") == 2, codes
+    assert _codes("apx401_hoststate_clean.py") == []
+
+
 def test_apx402_global_write():
     assert _codes("apx402_bad.py") == ["APX402"]
 

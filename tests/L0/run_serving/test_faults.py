@@ -1,0 +1,346 @@
+"""Chaos tier: deterministic fault injection against the serving
+engine's graceful-degradation layer.
+
+Every test drives the REAL scheduler/engine through the named fault
+sites (``serving.faults.SITES``) and checks the degradation contract:
+
+- every submitted request ends in a typed ``RequestOutcome``;
+- pool invariants hold after every tick (``audit=True``);
+- a stream untouched by faults is BIT-IDENTICAL to the fault-free
+  golden run, and a degraded request's tokens are a PREFIX of its
+  golden stream (quarantine never commits a corrupt token);
+- the same seed replays the same faults and the same outcomes.
+
+``eos_id=-1`` throughout: no token can match it, so golden streams
+always run to ``max_new_tokens`` and prefix assertions are exact.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from apex_tpu.models.gpt import gpt_tiny, init_gpt
+from apex_tpu.serving import (
+    AdmissionRejected, ContinuousBatchingScheduler, DeadlineExceeded,
+    DecodeEngine, FaultInjector, LivelockError, PagedDecodeEngine,
+    PoolInvariantError, Request, RetryBudgetExhausted, FINISH_REASONS,
+)
+from apex_tpu.serving.faults import SITES, fault_draw
+
+pytestmark = pytest.mark.chaos
+
+EOS = -1       # unreachable: every healthy stream runs to max_new_tokens
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                              hidden_dropout=0.0)
+    return cfg, init_gpt(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(model, injector=None, num_slots=2, num_pages=20):
+    cfg, params = model
+    return PagedDecodeEngine(params, cfg, num_slots=num_slots,
+                             max_len=MAX_LEN, num_pages=num_pages,
+                             page_size=4, buckets=(16, 32),
+                             injector=injector)
+
+
+def _drive(engine, reqs, **kw):
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS, **kw)
+    for r in reqs:
+        sched.submit(r)
+    return sched, sched.run()
+
+
+def _golden(model, reqs, num_slots=2):
+    _, outs = _drive(_engine(model, num_slots=num_slots), reqs)
+    return outs
+
+
+def _check_contract(sched, reqs, golden):
+    """The degradation contract every chaos run must satisfy."""
+    assert sorted(sched.outcomes) == list(range(len(reqs)))
+    for rid, out in sched.outcomes.items():
+        assert out.reason in FINISH_REASONS
+        want = golden[rid]
+        if out.ok:
+            assert list(out.tokens) == want, f"request {rid} diverged"
+        else:   # degraded: committed tokens are a golden prefix
+            assert list(out.tokens) == want[:len(out.tokens)], \
+                f"request {rid}: degraded stream is not a golden prefix"
+
+
+# -- the injector itself -----------------------------------------------------
+
+def test_fault_draw_is_pure():
+    """Schedules are pure functions of (seed, site, index) — the
+    replay guarantee rests on this, not on any RNG state."""
+    assert fault_draw(3, "sample", 7) == fault_draw(3, "sample", 7)
+    draws = {fault_draw(s, site, i) for s in (0, 1) for site in SITES
+             for i in (0, 5)}
+    assert len(draws) == 2 * len(SITES) * 2  # no collisions across keys
+    u01s = [fault_draw(0, "pool_alloc", i)[0] for i in range(200)]
+    assert all(0.0 <= u < 1.0 for u in u01s)
+    # roughly uniform: a rate-0.5 site fires about half the time
+    assert 60 < sum(u < 0.5 for u in u01s) < 140
+
+
+def test_injector_inert_by_default_and_validates_sites():
+    inert = FaultInjector()
+    assert not inert.armed
+    assert all(not inert.fire(s) for s in SITES for _ in range(50))
+    assert inert.counts == {s: 0 for s in SITES}
+    assert inert.calls("sample") == 50
+    with pytest.raises(ValueError, match="unknown fault sites"):
+        FaultInjector(rates={"warp_core": 1.0})
+    with pytest.raises(ValueError, match="unknown fault sites"):
+        FaultInjector(schedule={"holodeck": (0,)})
+    with pytest.raises(KeyError):
+        inert.fire("not_a_site")
+
+
+def test_injector_schedule_replays_bit_for_bit():
+    """Same seed, same visit order -> same fired pattern; pinned
+    schedule entries fire regardless of rates."""
+    a = FaultInjector(seed=11, rates={"decode_exec": 0.3})
+    b = FaultInjector(seed=11, rates={"decode_exec": 0.3})
+    pat_a = [a.draw("decode_exec") for _ in range(64)]
+    assert pat_a == [b.draw("decode_exec") for _ in range(64)]
+    assert any(f for f, _ in pat_a)
+    c = FaultInjector(seed=12, rates={"decode_exec": 0.3})
+    assert pat_a != [c.draw("decode_exec") for _ in range(64)]
+    pinned = FaultInjector(schedule={"prefill_exec": (2,)})
+    assert [pinned.fire("prefill_exec") for _ in range(4)] == [
+        False, False, True, False]
+
+
+# -- one site at a time, pinned schedules ------------------------------------
+
+def test_pool_alloc_fault_recovers_to_golden(model):
+    """A transient allocation refusal parks the admission (typed
+    internally as PoolExhausted, no retry charged — capacity is not the
+    request's fault) and the next tick succeeds bit-identically."""
+    reqs = [Request(prompt=(7, 11, 13, 17, 19), max_new_tokens=4)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"pool_alloc": (0,)}))
+    sched, outs = _drive(eng, reqs, audit=True)
+    assert outs == golden
+    assert sched.stats.pool_exhausted == 1
+    assert sched.stats.retries == 0
+    assert sched.outcomes[0].ok
+
+
+def test_cow_clone_fault_preempts_and_recovers(model):
+    """A failed copy-on-write clone preempts the slot (pages released,
+    request requeued with its progress); the resumed stream matches the
+    fault-free run exactly."""
+    reqs = [Request(prompt=(7, 11, 13, 17, 19), max_new_tokens=4)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"cow_clone": (0,)}))
+    sched, outs = _drive(eng, reqs, audit=True)
+    assert outs == golden
+    assert sched.stats.preemptions == 1
+    assert sched.stats.cow_copies >= 1  # the retried clone succeeded
+    assert sched.outcomes[0].ok
+
+
+def test_prefill_exec_fault_retries_to_golden(model):
+    """A transient prefill failure charges the retry budget and leaves
+    nothing behind (audit on); the retried admission is bit-identical."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=4)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"prefill_exec": (0,)}))
+    sched, outs = _drive(eng, reqs, audit=True)
+    assert outs == golden
+    assert sched.stats.retries == 1
+    assert sched.outcomes[0].ok and sched.outcomes[0].retries == 1
+
+
+def test_decode_nan_quarantine_keeps_cotenant_bit_identical(model):
+    """A NaN decode row quarantines ONE slot. With a zero retry budget
+    the victim terminates typed, its tokens a golden prefix — and the
+    co-tenant stream must be bit-identical to the fault-free run (the
+    corrupt row never touches other slots' logits or keys)."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=5),
+            Request(prompt=(23, 29), max_new_tokens=5)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"decode_exec": (0,)}))
+    sched, _ = _drive(eng, reqs, audit=True, max_retries=0)
+    assert sched.stats.nan_events == 1
+    bad = [rid for rid, o in sched.outcomes.items() if not o.ok]
+    assert len(bad) == 1
+    victim = sched.outcomes[bad[0]]
+    assert victim.reason == "retry_budget"
+    assert isinstance(victim.error, RetryBudgetExhausted)
+    # first token (from prefill) committed, the corrupt one never was
+    assert list(victim.tokens) == golden[bad[0]][:1]
+    ok = (set(sched.outcomes) - set(bad)).pop()
+    assert list(sched.outcomes[ok].tokens) == golden[ok]
+
+
+def test_decode_nan_quarantine_retry_is_bit_identical(model):
+    """Same fault, default retry budget: the victim resumes from its
+    committed tokens and BOTH streams equal the golden run exactly."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=5),
+            Request(prompt=(23, 29), max_new_tokens=5)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"decode_exec": (0,)}))
+    sched, outs = _drive(eng, reqs, audit=True)
+    assert outs == golden
+    assert sched.stats.nan_events == 1
+    assert all(o.ok for o in sched.outcomes.values())
+
+
+def test_sample_fault_at_admission_recovers(model):
+    """An out-of-vocabulary first token is caught by the admission
+    range gate; the request retries and matches golden."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=4,
+                    temperature=0.8, seed=5)]
+    golden = _golden(model, reqs)
+    eng = _engine(model, FaultInjector(schedule={"sample": (0,)}))
+    sched, outs = _drive(eng, reqs, audit=True)
+    assert outs == golden
+    assert sched.stats.bad_samples == 1
+    assert sched.outcomes[0].ok and sched.outcomes[0].retries == 1
+
+
+# -- typed terminations ------------------------------------------------------
+
+def test_retry_budget_exhausted_surfaces_typed(model):
+    """A persistently failing request terminates with
+    ``RetryBudgetExhausted`` carrying its id and retry count — it never
+    wedges the scheduler."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=4)]
+    eng = _engine(model,
+                  FaultInjector(schedule={"prefill_exec": range(10)}))
+    sched, outs = _drive(eng, reqs, audit=True, max_retries=2)
+    assert outs == [[]]
+    out = sched.outcomes[0]
+    assert out.reason == "retry_budget" and not out.ok
+    assert isinstance(out.error, RetryBudgetExhausted)
+    assert out.error.request_id == 0
+    assert out.retries == 3  # budget of 2 + the exhausting charge
+
+
+def test_deadline_exceeded_queued_and_mid_decode(model):
+    """Deadlines are scheduler ticks — deterministic. A request expiring
+    while queued ends empty; one expiring mid-decode keeps its golden
+    prefix."""
+    probe = Request(prompt=(7, 11, 13), max_new_tokens=8)
+    golden = _golden(model, [probe], num_slots=1)
+    # starved in the queue behind a slot hog
+    hog = Request(prompt=(23, 29), max_new_tokens=8)
+    starved = dataclasses.replace(probe, deadline_ticks=2)
+    sched, _ = _drive(_engine(model, num_slots=1), [hog, starved])
+    out = sched.outcomes[1]
+    assert out.reason == "deadline" and isinstance(out.error,
+                                                   DeadlineExceeded)
+    assert out.tokens == ()
+    assert sched.stats.deadline_expired == 1
+    # cut mid-decode: tokens committed so far are a golden prefix
+    cut = dataclasses.replace(probe, deadline_ticks=3)
+    sched2, _ = _drive(_engine(model, num_slots=1), [cut])
+    out2 = sched2.outcomes[0]
+    assert out2.reason == "deadline"
+    assert 0 < len(out2.tokens) < len(golden[0])
+    assert list(out2.tokens) == golden[0][:len(out2.tokens)]
+
+
+def test_admission_backpressure(model):
+    """A bounded queue sheds load typed instead of growing without
+    bound; accepted requests are unaffected."""
+    eng = _engine(model, num_slots=2)
+    sched = ContinuousBatchingScheduler(eng, eos_id=EOS, max_queue=2)
+    sched.submit(Request(prompt=(7, 11), max_new_tokens=2))
+    sched.submit(Request(prompt=(13, 17), max_new_tokens=2))
+    with pytest.raises(AdmissionRejected):
+        sched.submit(Request(prompt=(19, 23), max_new_tokens=2))
+    assert sched.stats.admission_rejections == 1
+    outs = sched.run()
+    assert len(outs) == 2 and all(len(t) == 2 for t in outs)
+    # queue drained: there is room again
+    sched.submit(Request(prompt=(19, 23), max_new_tokens=2))
+
+
+def test_livelock_watchdog_raises_with_diagnostics(model):
+    """Regression for the PR-8 COW livelock, generalized: force the
+    unfixable variant (every page always claims to need a copy on an
+    exact-fit pool) and the watchdog must raise a diagnostic
+    ``LivelockError`` — stuck request set + pool snapshot — instead of
+    spinning forever."""
+    from apex_tpu.serving.cache import RESERVED_PAGES
+
+    cfg, params = model
+    eng = PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                            num_pages=2 + RESERVED_PAGES, page_size=4,
+                            buckets=(16, 32))
+    eng.pool.needs_copy = lambda page: True   # re-create the bug, hard
+    sched = ContinuousBatchingScheduler(eng, eos_id=EOS,
+                                        watchdog_limit=8)
+    sched.submit(Request(prompt=(7, 11, 13, 17, 19), max_new_tokens=3))
+    with pytest.raises(LivelockError) as exc:
+        sched.run()
+    stuck = exc.value.stuck
+    assert stuck["queued"] == [0] or stuck["slots"] == {0: 0}
+    # the cycle ends each tick preempted: pages released back, nothing
+    # leaked — the snapshot is the diagnostic that shows the pool was
+    # NOT exhausted, i.e. a logic livelock rather than real pressure
+    assert exc.value.pool["num_free"] == 2
+    assert exc.value.pool["refcounts"] == {}
+    assert exc.value.pool["slot_pages"] == [[]]
+
+
+def test_invariant_audit_catches_corruption(model):
+    """The audit actually detects broken books, host side and device
+    side (a green chaos run is only meaningful if it can fail)."""
+    eng = _engine(model, num_slots=1)
+    eng.prefill(0, (7, 11, 13, 17, 19))
+    eng.check_invariants()  # healthy baseline
+    # host side: a slot claiming a reference the pool never granted
+    eng._slot_pages[0].append(eng._slot_pages[0][0])
+    with pytest.raises(PoolInvariantError, match="out of balance"):
+        eng.check_invariants()
+    eng._slot_pages[0].pop()
+    eng.check_invariants()
+    # device side: block table repointed behind the allocator's back
+    eng.cache = eng.cache._replace(
+        block_tables=eng.cache.block_tables.at[0, 0].set(9))
+    with pytest.raises(PoolInvariantError, match="device row"):
+        eng.check_invariants()
+
+
+# -- randomized multi-fault chaos --------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_multi_fault_chaos_is_typed_prefixed_and_replayable(model, seed):
+    """Randomized faults at every site at once, invariants audited
+    after every tick. Every request must end typed; healthy outcomes
+    equal the golden run bit-for-bit, degraded ones are golden
+    prefixes; and replaying the same seed reproduces the run exactly."""
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=5),
+            Request(prompt=(17, 19), max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=(7, 11, 13, 29), max_new_tokens=4),
+            Request(prompt=(23, 29, 31, 37, 41), max_new_tokens=6),
+            Request(prompt=(7, 11, 13), max_new_tokens=5,
+                    temperature=0.7, seed=9)]
+    golden = _golden(model, reqs)
+    rates = {"pool_alloc": 0.1, "cow_clone": 0.2, "prefill_exec": 0.15,
+             "decode_exec": 0.1, "sample": 0.1}
+
+    def chaos_run():
+        eng = _engine(model, FaultInjector(seed=seed, rates=rates),
+                      num_pages=12)
+        sched, _ = _drive(eng, reqs, audit=True)
+        return sched
+
+    sched = chaos_run()
+    _check_contract(sched, reqs, golden)
+    replay = chaos_run()
+    assert replay.outcomes == sched.outcomes
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts

@@ -12,7 +12,9 @@ import numpy as np
 import pytest
 
 from apex_tpu.models.gpt import gpt_tiny, init_gpt
-from apex_tpu.serving import PagePool, PagedDecodeEngine, prefix_page_keys
+from apex_tpu.serving import (
+    PagePool, PagedDecodeEngine, PoolExhausted, prefix_page_keys,
+)
 from apex_tpu.serving.cache import RESERVED_PAGES, SCRATCH_PAGE
 
 S_MAX = 32
@@ -171,20 +173,31 @@ def test_cow_does_not_perturb_sharing_request():
     assert len(eng2_pages) == 2 and eng2_pages[1] == shared
 
 
-def test_prefill_returns_none_when_out_of_pages():
+def test_prefill_raises_pool_exhausted_when_out_of_pages():
     """An admission the pool can't cover (even after LRU eviction)
-    returns None and leaks nothing — every transient reference is
-    rolled back so the request can be retried after evictions."""
+    raises typed ``PoolExhausted`` — carrying need/free/cached — and
+    leaks nothing: every transient reference is rolled back so the
+    request can be retried after evictions. ``try_prefill`` keeps the
+    legacy None shim for direct drivers."""
     cfg = _cfg()
     params = init_gpt(jax.random.PRNGKey(0), cfg)
     eng = _engine(params, cfg, num_pages=RESERVED_PAGES + 3,
                   prefix_sharing=False)
     assert eng.prefill(0, [5, 7, 11, 13, 17, 19, 23, 29]) is not None
     free_before = eng.pool.num_free
-    assert eng.prefill(1, [2, 3, 4, 6, 8, 9, 10, 12]) is None
+    with pytest.raises(PoolExhausted) as exc:
+        eng.prefill(1, [2, 3, 4, 6, 8, 9, 10, 12])
+    assert exc.value.need == 2
+    assert exc.value.free == free_before
+    assert exc.value.cached == 0
     assert eng.pool.num_free == free_before  # rollback, no leak
+    eng.check_invariants()                   # books balance post-rollback
+    # compat shim: same exhaustion as a None, for direct drivers
+    assert eng.try_prefill(1, [2, 3, 4, 6, 8, 9, 10, 12]) is None
+    assert eng.pool.num_free == free_before
     eng.free_slot(0)
     assert eng.pool.num_free == 3
+    eng.check_invariants()
 
 
 def test_page_demand_rejects_oversized_requests():
