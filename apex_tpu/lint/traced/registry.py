@@ -706,6 +706,62 @@ def _paged_decode_step_medium_ragged_entry():
     return build
 
 
+def _spec_verify_step_entry(tp=None):
+    """Speculative verify: k+1 = 4 candidate positions per slot against
+    the paged pool — k1 unrolled row scatters through the block table,
+    then gather + per-query masked attend. Same 4-leaf cache donation
+    as paged decode (lengths/block tables come back via the self-row
+    rewrite, since verify leaves them numerically untouched)."""
+    def build():
+        from apex_tpu.serving.decode import (
+            make_paged_verify_fn, make_tp_paged_verify_fn,
+        )
+
+        cfg = _serving_cfg()
+        params, cache = _paged_serving_args(cfg)
+        if tp is None:
+            fn = make_paged_verify_fn(cfg)
+        else:
+            from apex_tpu.models.gpt import GPTModel
+
+            fn = make_tp_paged_verify_fn(GPTModel(cfg, tp_size=tp))
+        return fn, (params, cache, _sds((2, 4), "int32"))
+
+    return build
+
+
+def _spec_verify_step_medium_ragged_entry():
+    """The verify step at the r10 ragged medium shape (32 slots, bf16
+    params, uniform 32..512 ladder), k+1 = 4 positions per slot —
+    cost-tier only. Its budgets.json row divided by the expected
+    committed tokens per slot at the bench acceptance rate is the
+    bytes/accepted-token headline BASELINE.md r11 prices against the
+    plain-decode ``model_bytes_per_token``."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig, init_gpt
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.decode import make_paged_verify_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        fn = make_paged_verify_fn(cfg)
+        return fn, (params, cache, _sds((slots, 4), "int32"))
+
+    return build
+
+
 def _decode_step_medium_entry():
     """The BASELINE.md r8 roofline shape: gpt_medium-class decode, bf16
     params, 32 slots parked at depth 512 (the steady-state mid-cache
@@ -990,6 +1046,16 @@ def repo_entries() -> List[TraceEntry]:
                    _paged_decode_step_entry(tp=2),
                    checks=("precision", "memory", "schedule", "aliases"),
                    mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=4),
+        # speculative verify: same donated 4-leaf paged cache as the
+        # decode step, k+1 query positions per slot
+        TraceEntry("gpt_spec_verify_step", "apex_tpu.serving.decode",
+                   _spec_verify_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=4),
+        TraceEntry("gpt_spec_verify_step_tp2", "apex_tpu.serving.decode",
+                   _spec_verify_step_entry(tp=2),
+                   checks=("precision", "memory", "schedule", "aliases"),
+                   mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=4),
         # cost-tier anchor for the BASELINE r8/r9 decode roofline; no
         # APX5xx checks (the tiny-shape decode entries above carry them
         # — this one exists so budgets.json pins the headline bytes)
@@ -1001,6 +1067,12 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_paged_decode_step_medium_ragged",
                    "apex_tpu.serving.decode",
                    _paged_decode_step_medium_ragged_entry(), checks=()),
+        # r11: the verify step at the same ragged shape — one parameter
+        # read priced over k+1 candidate positions; budgets.json pins
+        # the bytes/accepted-token headline (BASELINE.md r11)
+        TraceEntry("gpt_spec_verify_step_medium_ragged",
+                   "apex_tpu.serving.decode",
+                   _spec_verify_step_medium_ragged_entry(), checks=()),
         TraceEntry("fused_softmax_fwd_bwd",
                    "apex_tpu.transformer.functional.fused_softmax",
                    _fused_softmax_entry()),

@@ -394,6 +394,11 @@ def _paged_serving_cfg(which):
             return fn, (params, cache, _sds((1, 16), "int32"),
                         _sds((16,), "int32"), _sds((), "int32"),
                         _sds((1,), "int32"), _sds((2,), "int32"))
+        if which == "verify":
+            from apex_tpu.serving.decode import make_paged_verify_fn
+
+            fn = make_paged_verify_fn(cfg)
+            return fn, (params, cache, _sds((2, 4), "int32"))
         fn = make_paged_decode_fn(cfg)
         return fn, (params, cache, _sds((2,), "int32"),
                     _sds((2,), "bool"))
@@ -429,6 +434,8 @@ def repo_configs() -> List[Config]:
                        _paged_serving_cfg("prefill")))
     cfgs.append(Config("gpt_paged_decode_step", "apex_tpu.serving.decode",
                        _paged_serving_cfg("decode")))
+    cfgs.append(Config("gpt_spec_verify_step", "apex_tpu.serving.decode",
+                       _paged_serving_cfg("verify")))
     return cfgs
 
 

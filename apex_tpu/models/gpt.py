@@ -445,6 +445,128 @@ def _block_decode_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
     return x + mlp, k_pages, v_pages
 
 
+def _verify_attention(q_k_v: jax.Array, k_cache: jax.Array,
+                      v_cache: jax.Array, pos: jax.Array,
+                      cfg: GPTConfig, rope_freqs: Optional[jax.Array]):
+    """Multi-query (speculative *verify*) attention against a per-slot
+    KV cache: the k+1 generalization of :func:`_decode_attention`.
+
+    ``q_k_v`` is (b, k1, 3*h_local) — the last committed token plus k
+    drafted candidates, projected together; ``pos`` (b,) int32 is each
+    slot's committed length, so query j sits at absolute position
+    ``pos + j`` (RoPE rotates consecutive positions from ``pos``, the
+    same ``positions=`` contract the single-token path uses). All k1
+    new k/v rows are written (one ``lax.dynamic_update_slice`` block
+    per slot) BEFORE attending; the per-query mask ``s <= pos + j``
+    then admits exactly the committed history plus the candidate's own
+    prefix — write-then-attend, so every admitted row holds a real
+    value and logits row j equals a teacher-forced forward at position
+    ``pos + j`` bit-for-bit. Rows beyond the accepted prefix are never
+    admitted by any later mask before being re-written (positions are
+    monotone), which is the whole cache-rollback contract: rejection
+    needs no cleanup pass. Callers must guarantee ``pos + k1 <=
+    S_max`` (``dynamic_update_slice`` clamps out-of-range starts,
+    which would silently shift the write onto committed rows).
+    Scores/softmax run in fp32; returns (ctx (b, k1, h_local),
+    k_cache, v_cache).
+    """
+    b, k1, _ = q_k_v.shape
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, k1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+
+    def write(cache, new, p):
+        return lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    k_cache = jax.vmap(write)(k_cache, k.astype(k_cache.dtype), pos)
+    v_cache = jax.vmap(write)(v_cache, v.astype(v_cache.dtype), pos)
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / math.sqrt(hd)
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]        # (b, k1)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= qpos[:, None, :, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     v_cache.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, k1, -1), k_cache, v_cache
+
+
+def _block_verify(lp, x, k_cache, v_cache, pos, cfg, rope_freqs,
+                  qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_decode` over k1 candidate positions at once."""
+    att, k_cache, v_cache = _verify_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_cache, v_cache, pos, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_cache, v_cache
+
+
+def _paged_verify_attention(q_k_v: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, block_tables: jax.Array,
+                            pos: jax.Array, cfg: GPTConfig,
+                            rope_freqs: Optional[jax.Array]):
+    """Multi-query verify attention against the PAGED pool — the k+1
+    generalization of :func:`_paged_decode_attention`, with the same
+    write-then-attend and exact-zero masking contracts as
+    :func:`_verify_attention` (see there for the rollback argument).
+    k1 is static, so the scatter is k1 unrolled single-row updates of
+    the donated pool — each position lands in page ``block_tables[b,
+    (pos+j) // page_size]`` at row ``(pos+j) % page_size``. Callers
+    must hold pages allocated for all k1 positions (the scheduler's
+    ``prepare_decode(..., n_new=k1)``).
+    """
+    b, k1, _ = q_k_v.shape
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, k1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+    for j in range(k1):
+        p = pos + j
+        logical = jnp.clip(p // page_size, 0, block_tables.shape[1] - 1)
+        pages = jnp.take_along_axis(
+            block_tables, logical[:, None], 1)[:, 0]
+        rows = p % page_size
+        k_pages = k_pages.at[pages, :, rows].set(
+            k[:, :, j].astype(k_pages.dtype))
+        v_pages = v_pages.at[pages, :, rows].set(
+            v[:, :, j].astype(v_pages.dtype))
+    kg = k_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    vg = v_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    s_max = kg.shape[2] * kg.shape[3]
+    kg = kg.reshape(b, kg.shape[1], s_max, hd)
+    vg = vg.reshape(b, vg.shape[1], s_max, hd)
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) / math.sqrt(hd)
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]        # (b, k1)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= qpos[:, None, :, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     vg.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, k1, -1), k_pages, v_pages
+
+
+def _block_verify_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
+                        rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_verify` over the paged pool."""
+    att, k_pages, v_pages = _paged_verify_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, block_tables, pos, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages
+
+
 def _maybe_dropout(x, rate, rng, salt):
     if rng is None or rate <= 0:
         return x

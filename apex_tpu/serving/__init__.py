@@ -11,14 +11,21 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   pages instead of ``slots x S_max``);
 - ``paging``    — host-side page allocator: free list, refcounts,
   prefix-hash cache with LRU eviction, copy-on-write bookkeeping;
-- ``decode``    — bucketed prefill + single-token decode steps over
-  either layout, an unsharded path and a TP-sharded path (heads over
-  the ``model`` axis);
-- ``sampling``  — greedy / temperature / top-k under explicit PRNG keys;
+- ``decode``    — bucketed prefill + single-token decode + k+1-position
+  speculative *verify* steps over either layout, an unsharded path and
+  a TP-sharded path (heads over the ``model`` axis);
+- ``draft``     — host-side n-gram / prompt-lookup drafting for
+  self-speculative decode (pure function of the token history — no
+  draft model, no device work);
+- ``sampling``  — greedy / temperature / top-k / top-p under explicit
+  PRNG keys, including the speculative accept/resample grid whose
+  committed stream is bit-identical to plain decode;
 - ``scheduler`` — fixed-slot continuous batching (admit/evict on EOS or
   max-len; jit recompiles only per prompt bucket, never per request),
   over either engine; the paged engine adds prefix sharing at admission
-  and preemption-by-requeue when the pool runs dry;
+  and preemption-by-requeue when the pool runs dry; ``spec_k > 0``
+  turns ticks into draft → verify → accept steps committing 1..k+1
+  tokens per slot;
 - ``health``    — typed failure taxonomy (``PoolExhausted``,
   ``NonFiniteLogits``, ``RetryBudgetExhausted``, ...), per-engine
   ``ServingStats`` counters, and typed ``RequestOutcome`` records;
@@ -34,10 +41,12 @@ from apex_tpu.serving.cache import (  # noqa: F401
 )
 from apex_tpu.serving.decode import (  # noqa: F401
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
-    make_paged_prefill_fn, make_prefill_fn, make_tp_decode_fn,
-    make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
-    make_tp_prefill_fn,
+    make_paged_prefill_fn, make_paged_verify_fn, make_prefill_fn,
+    make_tp_decode_fn, make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
+    make_tp_paged_verify_fn, make_tp_prefill_fn, make_tp_verify_fn,
+    make_verify_fn,
 )
+from apex_tpu.serving.draft import ngram_draft  # noqa: F401
 from apex_tpu.serving.faults import (  # noqa: F401
     SITES, FaultInjector, InjectedFault, fault_draw,
 )
@@ -47,7 +56,9 @@ from apex_tpu.serving.health import (  # noqa: F401
     RetryBudgetExhausted, ServingError, ServingStats,
 )
 from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
-from apex_tpu.serving.sampling import finite_rows, sample_tokens  # noqa: F401
+from apex_tpu.serving.sampling import (  # noqa: F401
+    finite_rows, sample_token_grid, sample_tokens, speculative_accept,
+)
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
 )

@@ -22,6 +22,16 @@ Contracts:
   must match a full-sequence forward at the same positions to fp32
   tolerance (the headline serving contract; see
   ``tests/L0/run_serving``).
+- **verify** (speculative decoding) advances every slot over k+1
+  candidate positions at once — the last committed token plus k
+  drafted candidates — returning exact per-position logits
+  ``(B, k+1, V)``. K/V rows for ALL candidates are written before
+  attending (per-query ``s <= pos + j`` masks keep causality exact);
+  slot lengths are NOT advanced in-step — the host commits the
+  accepted prefix afterwards (``PagedDecodeEngine.commit``), so a
+  rejected candidate's row is simply never admitted by any later mask
+  before the next step re-writes it. That is the whole rollback
+  contract, and it is pinned by bit-identity tests.
 - both jitted steps DONATE the cache: the update lowers to an in-place
   buffer write instead of a fresh ``O(L·B·H·S·d)`` copy per token.
   APX512 (trace tier) verifies the donation survives into the jaxpr.
@@ -33,7 +43,8 @@ from jax import lax
 
 from apex_tpu.models.gpt import (
     GPTConfig, GPTModel, _block_decode, _block_decode_paged,
-    _block_prefill, _ln, _rope_or_none, _tied_lm_logits,
+    _block_prefill, _block_verify, _block_verify_paged, _ln,
+    _rope_or_none, _tied_lm_logits,
 )
 from apex_tpu.serving.cache import (
     KVCache, PagedKVCache, cache_partition_specs,
@@ -104,6 +115,44 @@ def _decode_core(params, cfg: GPTConfig, cache: KVCache, tokens, active,
     hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
     logits = logits_fn(params, hidden[:, 0])
     return KVCache(k, v, jnp.where(active, pos + 1, pos)), logits
+
+
+def _self_rewrite(x):
+    """Rewrite row 0 of ``x`` with itself. Numerically a no-op, but it
+    gives XLA an update op to land the donated buffer in — an output
+    that IS an invar gives the donation nothing to alias, and APX512
+    flags the dropped pair (the paged decode core's block-table idiom,
+    shared by the verify steps whose lengths pass through unchanged)."""
+    first = lax.dynamic_slice(x, (0,) * x.ndim, (1,) + x.shape[1:])
+    return lax.dynamic_update_slice(x, first, (0,) * x.ndim)
+
+
+def _verify_core(params, cfg: GPTConfig, cache: KVCache, tokens, *,
+                 embed_fn, dense_fns, logits_fn):
+    """Speculative *verify*: tokens (B, k1) int32 — column 0 is each
+    slot's last committed (pending) token, columns 1..k its drafted
+    candidates; row j attends at absolute position ``lengths + j``.
+    Returns (cache', logits (B, k1, V) fp32) where logits row j is
+    exactly the teacher-forced distribution for the token following
+    position ``lengths + j``. Lengths are NOT advanced — acceptance is
+    a host decision (the accepted count is only known after sampling),
+    committed via a tiny host-side ``_replace`` on the returned cache.
+    The caller guarantees ``lengths + k1 <= S_max`` for every slot
+    (the scheduler's headroom guard)."""
+    pos = cache.lengths
+    x = embed_fn(params, tokens, pos=pos)
+    freqs = _rope_or_none(cfg, cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kc, vc = layer_slice
+        x, kc, vc = _block_verify(lp, x, kc, vc, pos, cfg, freqs,
+                                  *dense_fns)
+        return x, (kc, vc)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden)
+    return KVCache(k, v, _self_rewrite(pos)), logits
 
 
 # ---------------------------------------------------------------------------
@@ -196,9 +245,34 @@ def _paged_decode_core(params, cfg: GPTConfig, cache: PagedKVCache,
     x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
     logits = logits_fn(params, hidden[:, 0])
-    bt = lax.dynamic_update_slice(
-        bt, lax.dynamic_slice(bt, (0, 0), (1, bt.shape[1])), (0, 0))
+    bt = _self_rewrite(bt)
     return PagedKVCache(k, v, jnp.where(active, pos + 1, pos), bt), logits
+
+
+def _paged_verify_core(params, cfg: GPTConfig, cache: PagedKVCache,
+                       tokens, *, embed_fn, dense_fns, logits_fn):
+    """:func:`_verify_core` over the page pool. The host has already
+    made every one of the k1 write targets exclusive
+    (``prepare_decode(..., n_new=k1)`` runs boundary allocation +
+    copy-on-write for every page the candidate positions touch), so
+    the unrolled scatters never land on a shared page. Lengths and
+    block tables ride the donated tuple through the self-row rewrite."""
+    pos = cache.lengths
+    bt = cache.block_tables
+    x = embed_fn(params, tokens, pos=pos)
+    freqs = _rope_or_none(cfg, bt.shape[1] * cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kp, vp = layer_slice
+        x, kp, vp = _block_verify_paged(lp, x, kp, vp, bt, pos, cfg,
+                                        freqs, *dense_fns)
+        return x, (kp, vp)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden)
+    return PagedKVCache(k, v, _self_rewrite(pos), _self_rewrite(bt)), \
+        logits
 
 
 # ---------------------------------------------------------------------------
@@ -221,9 +295,10 @@ def _embed_unsharded(cfg: GPTConfig, compute_dtype):
             if pos is None:
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
-                # decode: each slot sits at its own absolute position
-                x = x + jnp.take(ptab, pos, axis=0).astype(
-                    x.dtype)[:, None, :]
+                # decode/verify: slot b's s tokens sit at absolute
+                # positions pos[b], pos[b]+1, ... (s = 1 for decode)
+                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
     return embed
 
@@ -290,6 +365,34 @@ def make_paged_decode_fn(cfg: GPTConfig, compute_dtype=None):
     return jax.jit(decode, donate_argnums=1)
 
 
+def make_verify_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(speculative verify) with the cache DONATED; one executable
+    per (cache shape, k1) — the scheduler runs a single k1 = spec_k + 1
+    bucket (shorter drafts pad with token 0; the host bounds acceptance
+    by the true draft length), so this compiles once."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def verify(params, cache, tokens):
+        return _verify_core(params, cfg, cache, tokens,
+                            embed_fn=embed, dense_fns=(_dense,) * 4,
+                            logits_fn=_logits_unsharded)
+
+    return jax.jit(verify, donate_argnums=1)
+
+
+def make_paged_verify_fn(cfg: GPTConfig, compute_dtype=None):
+    """jit(paged speculative verify), cache DONATED (4 alias pairs)."""
+    embed = _embed_unsharded(cfg, compute_dtype)
+
+    def verify(params, cache, tokens):
+        return _paged_verify_core(params, cfg, cache, tokens,
+                                  embed_fn=embed,
+                                  dense_fns=(_dense,) * 4,
+                                  logits_fn=_logits_unsharded)
+
+    return jax.jit(verify, donate_argnums=1)
+
+
 def make_copy_page_fn():
     """jit(copy one physical page across all layers), cache DONATED —
     the device half of copy-on-write: the host picks ``src``/``dst``
@@ -324,8 +427,8 @@ def _tp_fns(model: GPTModel):
             if pos is None:
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
-                x = x + jnp.take(ptab, pos, axis=0).astype(
-                    x.dtype)[:, None, :]
+                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
 
     def logits(params, hidden):
@@ -386,6 +489,29 @@ def make_tp_decode_fn(model: GPTModel, mesh=None):
     return jax.jit(sharded, donate_argnums=1)
 
 
+def make_tp_verify_fn(model: GPTModel, mesh=None):
+    """TP speculative verify: the (b, k1, V) logits leave through the
+    same vocab-sharded head + rank-order gather as decode's."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = cache_partition_specs()
+
+    def verify(params, cache, tokens):
+        return _verify_core(params, cfg, cache, tokens,
+                            embed_fn=embed, dense_fns=dense_fns,
+                            logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        verify, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
 def make_tp_paged_prefill_fn(model: GPTModel, mesh=None):
     """TP paged prefill: the pool's head axis shards over ``model``;
     block tables / page ids are replicated host decisions, so every
@@ -429,5 +555,26 @@ def make_tp_paged_decode_fn(model: GPTModel, mesh=None):
     sharded = ps.shard_map(
         decode, mesh=mesh,
         in_specs=(model.partition_specs(), cspecs, P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_paged_verify_fn(model: GPTModel, mesh=None):
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    embed, dense_fns, logits_fn = _tp_fns(model)
+    cspecs = paged_cache_partition_specs()
+
+    def verify(params, cache, tokens):
+        return _paged_verify_core(params, cfg, cache, tokens,
+                                  embed_fn=embed, dense_fns=dense_fns,
+                                  logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        verify, mesh=mesh,
+        in_specs=(model.partition_specs(), cspecs, P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)

@@ -1,0 +1,48 @@
+"""Host-side n-gram / prompt-lookup drafting for self-speculative decode.
+
+No draft model: candidate continuations come from the request's OWN
+token history (prompt + generated so far) — the prompt-lookup scheme.
+The current n-gram suffix of the history is matched against earlier
+occurrences; the tokens that followed the most recent earlier match
+become the draft. This is a pure function of the token-id sequence:
+deterministic, slot-placement-independent, and free (no device work) —
+exactly the properties the serving bit-identity contract needs, since
+a WRONG draft only costs verify throughput, never correctness (the
+verify + accept path resamples with the plain decode stream's keys).
+
+The drafter may return fewer than ``k`` tokens (including zero, when
+the suffix never recurred); the scheduler pads the verify bucket and
+bounds acceptance by the true draft length.
+"""
+
+from typing import List, Sequence
+
+__all__ = ["ngram_draft"]
+
+
+def ngram_draft(history: Sequence[int], k: int, *, max_ngram: int = 3,
+                min_ngram: int = 1) -> List[int]:
+    """Propose up to ``k`` draft tokens from ``history``.
+
+    Tries suffix n-grams longest-first (``max_ngram`` down to
+    ``min_ngram``): for each n, find the MOST RECENT earlier occurrence
+    of ``history[-n:]`` that has at least one continuation token
+    (the terminal self-match is excluded), and return the up-to-``k``
+    tokens that followed it. Longer suffixes are stronger evidence, so
+    the first hit wins; recency breaks ties within a length (repeated
+    phrases drift, and the latest occurrence tracks the current one
+    best). Returns ``[]`` when ``k <= 0``, the history is shorter than
+    ``min_ngram + 1``, or no suffix recurs.
+    """
+    if k <= 0 or min_ngram < 1 or max_ngram < min_ngram:
+        return []
+    hist = list(history)
+    n_hist = len(hist)
+    for n in range(min(max_ngram, n_hist - 1), min_ngram - 1, -1):
+        suffix = hist[n_hist - n:]
+        # latest start i with a continuation: i + n <= n_hist - 1, and
+        # i < n_hist - n excludes the suffix matching itself
+        for i in range(n_hist - n - 1, -1, -1):
+            if hist[i:i + n] == suffix:
+                return hist[i + n:i + n + k]
+    return []

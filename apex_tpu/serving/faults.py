@@ -29,6 +29,10 @@ executables stay fault-free):
                    always-on non-finite quarantine path
 ``sample``         one slot's sampled token is replaced with an
                    out-of-vocabulary id — exercises token validation
+``draft_exec``     one slot's n-gram draft raises :class:`InjectedFault`
+                   — the scheduler degrades that slot to an empty draft
+                   (plain decode pace) for the tick, charging no retry
+                   budget; the stream stays bit-identical
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from
@@ -41,7 +45,7 @@ from typing import Dict, Iterable, Mapping, Optional, Tuple
 
 #: The named fault sites, in the order the docs list them.
 SITES = ("pool_alloc", "cow_clone", "prefill_exec", "decode_exec",
-         "sample")
+         "sample", "draft_exec")
 
 
 class InjectedFault(RuntimeError):

@@ -137,6 +137,19 @@ class ServingStats:
     bad_samples: int = 0           # out-of-vocab sampled tokens
     deadline_expired: int = 0      # requests cut at deadline_ticks
     evictions: int = 0             # healthy completions freeing a slot
+    tokens_drafted: int = 0        # speculative candidates proposed
+    tokens_accepted: int = 0       # drafted candidates that committed
+    draft_faults: int = 0          # draft_exec faults (degraded ticks)
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted / drafted speculative candidates (0.0 before any
+        draft). The number that prices the verify step: at depth k and
+        acceptance rate a, the expected tokens per parameter read is
+        the expected accepted-prefix length + 1."""
+        if not self.tokens_drafted:
+            return 0.0
+        return self.tokens_accepted / self.tokens_drafted
 
     def as_dict(self) -> Dict[str, int]:
         return dataclasses.asdict(self)

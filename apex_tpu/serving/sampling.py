@@ -8,37 +8,111 @@ training side already holds (see ``tests/L0/run_serving``).
 
 One fused entry point handles the whole batch: per-slot temperature
 (``<= 0`` selects greedy) so mixed greedy/sampled slots decode in one
-jitted step instead of recompiling per request mix. ``top_k`` is static
-(part of the compiled program) — it is an engine-level setting, not a
-per-request one.
+jitted step instead of recompiling per request mix. ``top_k`` / ``top_p``
+are static (part of the compiled program) — engine-level settings, not
+per-request ones.
+
+Speculative decoding shares this surface. ``sample_token_grid`` runs
+the SAME sampler over the verify step's (B, k+1, V) logits, one key
+per (slot, position) — position j uses ``fold_in(seed, n_generated +
+j)``, i.e. exactly the key the plain decode stream would use for its
+(n_generated + j)-th token. The host accept walk then commits the
+longest prefix where the sampled token reproduces the draft, plus the
+first non-matching sample. Because the n-gram draft is deterministic
+(a point mass q = δ_d), this IS standard speculative sampling
+(Leviathan et al.): the accept probability min(1, p(d)/q(d)) at the
+drafted token is just p(d) — the chance the plain-key categorical
+draw lands on d — and the residual distribution on first rejection
+norm(max(p − q, 0)) is p restricted to tokens ≠ d, which is what the
+non-matching draw realizes. Greedy rows degenerate to
+longest-matching-argmax-prefix. Acceptance therefore changes only how
+many STEPS a stream takes, never which tokens it emits: speculative
+output is bit-identical to plain decode.
 """
 
 import jax
 import jax.numpy as jnp
 
 
+def _restrict(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Mask ``logits`` (…, V) to the top-k / nucleus support with
+    ``-inf`` (applied to RAW logits, before temperature, so the support
+    is temperature-independent — matching greedy's argmax view)."""
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p and top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]        # descending
+        probs = jax.nn.softmax(srt, axis=-1)
+        # keep a sorted token while the mass BEFORE it is < top_p: the
+        # smallest prefix whose mass reaches top_p (the argmax always
+        # survives — its "before" mass is 0)
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+        thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+    return logits
+
+
 def sample_tokens(logits: jax.Array, keys: jax.Array,
-                  temperature: jax.Array, top_k: int = 0) -> jax.Array:
+                  temperature: jax.Array, top_k: int = 0,
+                  top_p: float = 0.0) -> jax.Array:
     """logits (B, V) fp32; keys (B, 2) uint32 (stacked jax.random keys);
     temperature (B,) float — ``t <= 0`` means greedy for that slot, the
     scheduler's encoding for deterministic requests. ``top_k`` (static;
-    0 = full vocab) restricts sampling to each row's k largest logits.
-    Returns (B,) int32 token ids."""
+    0 = full vocab) restricts sampling to each row's k largest logits;
+    ``top_p`` (static; 0 or 1 = off) to the smallest set whose softmax
+    mass reaches p (nucleus sampling). Returns (B,) int32 token ids."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if top_k:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    logits = _restrict(logits, top_k, top_p)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(
         jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
+def sample_token_grid(logits: jax.Array, keys: jax.Array,
+                      temperature: jax.Array, top_k: int = 0,
+                      top_p: float = 0.0) -> jax.Array:
+    """:func:`sample_tokens` over a verify step's (B, k1, V) logits with
+    per-position keys (B, k1, 2): flattens to (B*k1, V), repeats each
+    slot's temperature over its k1 positions, and reshapes back to
+    (B, k1) int32. Position (b, j) draws with key[b, j] — the key the
+    plain stream uses for that slot's (n_generated + j)-th token — so
+    the committed prefix is bit-identical to plain decode."""
+    b, k1, v = logits.shape
+    toks = sample_tokens(logits.reshape(b * k1, v),
+                         keys.reshape(b * k1, 2),
+                         jnp.repeat(temperature, k1), top_k, top_p)
+    return toks.reshape(b, k1)
+
+
+def speculative_accept(tokens: jax.Array, drafts: jax.Array,
+                       draft_lens: jax.Array) -> jax.Array:
+    """Vectorized accept rule: ``tokens`` (B, k1) are the grid-sampled
+    tokens, ``drafts`` (B, k) the (0-padded) drafted candidates,
+    ``draft_lens`` (B,) the true draft lengths. Draft j is accepted iff
+    every draft before it matched its sampled token and ``tokens[:, j]
+    == drafts[:, j]`` with ``j < draft_len`` (pad positions never
+    match). Returns (B,) int32 accepted counts in [0, k]; the commit is
+    ``accepted + 1`` tokens — the accepted drafts plus the first
+    non-matching (or bonus k-th) sample, ``tokens[:, :accepted + 1]``.
+    Pure structure — no probabilities: the sampled grid already IS the
+    plain stream (see the module docstring), so acceptance is just
+    "did the plain stream reproduce the draft".
+    """
+    k = drafts.shape[1]
+    match = (tokens[:, :k] == drafts) & \
+        (jnp.arange(k)[None, :] < draft_lens[:, None])
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                   axis=1).astype(jnp.int32)
+
+
 def finite_rows(logits: jax.Array) -> jax.Array:
-    """(B,) bool — True where a row of ``logits`` is entirely finite.
-    The scheduler's always-on NaN/Inf quarantine gate: a device-side
-    reduction so each tick ships B bools to the host instead of the
-    (B, V) logits matrix. A False row is never sampled into a stream —
-    the slot is quarantined and the request retried
+    """(…, V) -> (…,) bool — True where a row of ``logits`` is entirely
+    finite. The scheduler's always-on NaN/Inf quarantine gate: a
+    device-side reduction so each tick ships B (or B×k1) bools to the
+    host instead of the logits matrix. A False row is never sampled
+    into a stream — the slot is quarantined and the request retried
     (``serving.health.NonFiniteLogits``)."""
     return jnp.all(jnp.isfinite(logits), axis=-1)

@@ -15,6 +15,22 @@ Determinism: every sampled token draws from
 request stream regenerates identical outputs regardless of how requests
 interleave across slots.
 
+Speculative decoding (``spec_k > 0``): each tick first asks the
+host-side n-gram drafter (``serving.draft``) for up to ``spec_k``
+candidate tokens per slot, then runs ONE verify step over the k+1
+candidate positions (``serving.decode``), samples every position with
+the key the plain stream would have used there
+(``fold_in(seed, n_generated + j)``), and commits the longest prefix
+where the samples reproduce the drafts, plus the first non-matching
+sample — 1..k+1 tokens per slot per tick. Because the keys are the
+plain stream's keys, the committed tokens are BIT-IDENTICAL to plain
+decode; acceptance only changes the step count (see
+``serving.sampling``). A tick that commits m tokens advances the
+scheduler clock by m, so deadlines and watchdog progress stay
+comparable between modes. The tick degrades to a plain decode step
+whenever every draft is empty (including a fired ``draft_exec`` fault
+site) or any active slot lacks ``spec_k + 1`` rows of cache headroom.
+
 Failure is an expected state (the dynamic-loss-scaler discipline,
 applied to serving — see ``serving.health``): pool exhaustion, NaN
 logits, bad samples, and transient exec faults all degrade gracefully
@@ -22,9 +38,9 @@ instead of crashing or spinning:
 
 - **typed taxonomy** — ``PagedDecodeEngine.prefill`` raises
   :class:`~apex_tpu.serving.health.PoolExhausted` instead of returning
-  ``None`` (``try_prefill`` keeps the None shim for direct drivers);
-  every request ends in a :class:`~apex_tpu.serving.health.\
-RequestOutcome` with a typed reason, in ``scheduler.outcomes``.
+  ``None``; every request ends in a
+  :class:`~apex_tpu.serving.health.RequestOutcome` with a typed
+  reason, in ``scheduler.outcomes``.
 - **quarantine + retry budget** — non-finite logits or an
   out-of-vocabulary sampled token quarantines the slot: the corrupt
   token is never committed, the slot is freed and the request requeued
@@ -76,15 +92,19 @@ from apex_tpu.serving.cache import (
 )
 from apex_tpu.serving.decode import (
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
-    make_paged_prefill_fn, make_prefill_fn,
+    make_paged_prefill_fn, make_paged_verify_fn, make_prefill_fn,
+    make_verify_fn,
 )
+from apex_tpu.serving.draft import ngram_draft
 from apex_tpu.serving.faults import FaultInjector, InjectedFault
 from apex_tpu.serving.health import (
     AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
     PoolExhausted, RequestOutcome, RetryBudgetExhausted, ServingStats,
 )
 from apex_tpu.serving.paging import PagePool, prefix_page_keys
-from apex_tpu.serving.sampling import finite_rows, sample_tokens
+from apex_tpu.serving.sampling import (
+    finite_rows, sample_token_grid, sample_tokens,
+)
 from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
 
 
@@ -113,10 +133,12 @@ class _Slot:
 
 
 class DecodeEngine:
-    """Owns the params, the cache, and the three jitted programs
-    (bucketed prefill, batched decode, sampling). ``top_k`` is static —
-    an engine setting, compiled into the sampler. ``injector`` hooks
-    the fault sites (inert by default); ``stats`` is the
+    """Owns the params, the cache, and the jitted programs (bucketed
+    prefill, batched decode, speculative verify, sampling). ``top_k``,
+    ``top_p`` and ``spec_k`` are static — engine settings, compiled
+    into the programs (``spec_k`` is the DRAFT DEPTH; 0 disables
+    speculation). ``injector`` hooks the fault sites (inert by
+    default); ``stats`` is the
     :class:`~apex_tpu.serving.health.ServingStats` counter block the
     scheduler shares."""
 
@@ -124,6 +146,7 @@ class DecodeEngine:
 
     def __init__(self, params, cfg: GPTConfig, num_slots: int,
                  max_len: int, cache_dtype=jnp.bfloat16, top_k: int = 0,
+                 top_p: float = 0.0, spec_k: int = 0,
                  buckets: Optional[Sequence[int]] = None,
                  compute_dtype=None,
                  injector: Optional[FaultInjector] = None):
@@ -138,12 +161,21 @@ class DecodeEngine:
         self.buckets = tuple(sorted({min(int(b), max_len)
                                      for b in buckets}))
         self.top_k = top_k
+        self.top_p = top_p
+        self.spec_k = spec_k
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
         self.cache = init_cache(cfg, num_slots, max_len, cache_dtype)
         self._prefill = make_prefill_fn(cfg, compute_dtype)
         self._decode = make_decode_fn(cfg, compute_dtype)
-        self._sample = jax.jit(sample_tokens, static_argnames="top_k")
+        self._verify = make_verify_fn(cfg, compute_dtype)
+        self._init_samplers()
+
+    def _init_samplers(self) -> None:
+        self._sample = jax.jit(sample_tokens,
+                               static_argnames=("top_k", "top_p"))
+        self._sample_grid = jax.jit(sample_token_grid,
+                                    static_argnames=("top_k", "top_p"))
         self._finite = jax.jit(finite_rows)
 
     def prefill(self, slot: int, prompt: Sequence[int]) -> jax.Array:
@@ -164,16 +196,6 @@ class DecodeEngine:
             self.params, self.cache, ids, mask, jnp.int32(slot))
         return logits
 
-    def try_prefill(self, slot: int,
-                    prompt: Sequence[int]) -> Optional[jax.Array]:
-        """Compat shim for direct drivers predating the typed taxonomy:
-        ``None`` on :class:`PoolExhausted` instead of the raise. New
-        code should call :meth:`prefill` and catch the typed error."""
-        try:
-            return self.prefill(slot, prompt)
-        except PoolExhausted:
-            return None
-
     def decode(self, tokens: jax.Array, active: jax.Array) -> jax.Array:
         """One token for every slot; ``active`` gates length advance.
         Returns (num_slots, V) fp32 logits. An armed ``decode_exec``
@@ -191,7 +213,8 @@ class DecodeEngine:
         return logits
 
     def sample(self, logits, keys, temperature) -> jax.Array:
-        toks = self._sample(logits, keys, temperature, top_k=self.top_k)
+        toks = self._sample(logits, keys, temperature, top_k=self.top_k,
+                            top_p=self.top_p)
         fired, payload = self.injector.draw("sample")
         if fired:
             # out-of-vocabulary id: negative, so it can never collide
@@ -205,14 +228,69 @@ class DecodeEngine:
         sample (see :func:`~apex_tpu.serving.sampling.finite_rows`)."""
         return self._finite(logits)
 
+    # -- speculative decoding -------------------------------------------
+
+    def draft(self, history: Sequence[int]) -> List[int]:
+        """Host-side n-gram draft of up to ``spec_k`` candidates from
+        one slot's prompt+generated history. An armed ``draft_exec``
+        fault site raises :class:`InjectedFault` — the scheduler
+        degrades that slot to an empty draft (plain decode pace) for
+        the tick; drafting is best-effort, so no retry budget is
+        charged."""
+        fired, _ = self.injector.draw("draft_exec")
+        if fired:
+            raise InjectedFault("draft_exec",
+                                self.injector.calls("draft_exec") - 1)
+        return ngram_draft(history, self.spec_k)
+
+    def verify(self, tokens: jax.Array) -> jax.Array:
+        """One speculative verify step: ``tokens`` (num_slots, spec_k+1)
+        int32 — column 0 the pending token, columns 1.. the (0-padded)
+        drafts. Returns (num_slots, spec_k+1, V) fp32 logits; slot
+        lengths are committed separately (:meth:`commit`) once the host
+        accept walk knows each slot's count. The ``decode_exec`` fault
+        site covers this step too (the victim row goes NaN across all
+        positions, post-jit)."""
+        self.cache, logits = self._verify(self.params, self.cache,
+                                          tokens)
+        fired, payload = self.injector.draw("decode_exec")
+        if fired:
+            victim = int(payload % logits.shape[0])
+            logits = logits.at[victim].set(jnp.nan)
+        return logits
+
+    def commit(self, counts: Sequence[int]) -> None:
+        """Advance slot lengths by each slot's committed token count —
+        the host half of the verify step's rollback contract: rows
+        beyond ``lengths + count`` were written but are never admitted
+        by any mask before the next step re-writes them."""
+        self.cache = self.cache._replace(
+            lengths=self.cache.lengths
+            + jnp.asarray(counts, jnp.int32))
+
+    def sample_grid(self, logits, keys, temperature) -> jax.Array:
+        """Sample every (slot, position) of a verify step's logits with
+        its own key; the ``sample`` fault site corrupts the victim
+        slot's FIRST position (the one a plain tick would have drawn),
+        so the scheduler's range gate quarantines before any commit."""
+        toks = self._sample_grid(logits, keys, temperature,
+                                 top_k=self.top_k, top_p=self.top_p)
+        fired, payload = self.injector.draw("sample")
+        if fired:
+            victim = int(payload % toks.shape[0])
+            toks = toks.at[victim, 0].set(jnp.int32(-1 - payload % 7))
+        return toks
+
     # scheduler hooks, no-ops for the dense engine: a cache row needs
     # no per-token capacity and frees by being overwritten
     def page_demand(self, total_len: int) -> None:
         """Validate a request's worst-case capacity need at submit."""
 
-    def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
-        """Make every slot's next write target exclusive; returns slots
-        that had to be preempted (none for the dense cache)."""
+    def prepare_decode(self, positions: Dict[int, int],
+                       n_new: int = 1) -> List[int]:
+        """Make every slot's next ``n_new`` write targets exclusive;
+        returns slots that had to be preempted (none for the dense
+        cache)."""
         return []
 
     def free_slot(self, slot: int) -> None:
@@ -249,6 +327,7 @@ class PagedDecodeEngine(DecodeEngine):
     def __init__(self, params, cfg: GPTConfig, num_slots: int,
                  max_len: int, num_pages: int, page_size: int,
                  cache_dtype=jnp.bfloat16, top_k: int = 0,
+                 top_p: float = 0.0, spec_k: int = 0,
                  buckets: Optional[Sequence[int]] = None,
                  compute_dtype=None,
                  free_order: Optional[Sequence[int]] = None,
@@ -271,6 +350,8 @@ class PagedDecodeEngine(DecodeEngine):
                 f"paged prefill writes whole pages: buckets {bad} are "
                 f"not multiples of page_size {page_size}")
         self.top_k = top_k
+        self.top_p = top_p
+        self.spec_k = spec_k
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
         self.cache = init_paged_cache(cfg, num_slots, max_len, num_pages,
@@ -280,9 +361,9 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
         self._prefill = make_paged_prefill_fn(cfg, compute_dtype)
         self._decode = make_paged_decode_fn(cfg, compute_dtype)
+        self._verify = make_paged_verify_fn(cfg, compute_dtype)
         self._copy = make_copy_page_fn()
-        self._sample = jax.jit(sample_tokens, static_argnames="top_k")
-        self._finite = jax.jit(finite_rows)
+        self._init_samplers()
 
     def page_demand(self, total_len: int) -> None:
         need = max_pages_per_slot(min(total_len, self.max_len),
@@ -352,51 +433,60 @@ class PagedDecodeEngine(DecodeEngine):
             self.pool.register_prefix(keys, pages)
         return logits
 
-    def prepare_decode(self, positions: Dict[int, int]) -> List[int]:
-        """Before a decode tick writes row ``pos`` for each slot: cross
-        a page boundary by allocating a fresh page, and clone (COW) a
-        shared page about to receive an appended row — unless the
-        failed clone alloc's registry eviction left the slot sole
-        owner, in which case the append proceeds in place. A slot the
-        pool genuinely cannot serve (or whose ``cow_clone`` fault site
-        fired) is preempted — its pages are released (often unblocking
-        the rest of the batch) and the caller requeues the request."""
+    def prepare_decode(self, positions: Dict[int, int],
+                       n_new: int = 1) -> List[int]:
+        """Before a tick writes rows ``pos .. pos + n_new - 1`` for each
+        slot (``n_new = spec_k + 1`` on a verify tick): cross each page
+        boundary by allocating a fresh page, and clone (COW) a shared
+        page about to receive an appended row — unless the failed clone
+        alloc's registry eviction left the slot sole owner, in which
+        case the append proceeds in place. Pages past the committed
+        length may already exist from a prior verify tick's overshoot;
+        they were allocated privately then and are simply reused. A
+        slot the pool genuinely cannot serve (or whose ``cow_clone``
+        fault site fired) is preempted — its pages are released (often
+        unblocking the rest of the batch) and the caller requeues the
+        request."""
         preempted: List[int] = []
         for i, pos in sorted(positions.items()):
             pages = self._slot_pages[i]
-            idx = pos // self.page_size
-            if idx == len(pages):                       # page boundary
-                p = self.pool.alloc()
-                if p is None:
-                    self._preempt(i, preempted)
-                    continue
-                pages.append(p)
-                self.cache = self.cache._replace(
-                    block_tables=self.cache.block_tables.at[i, idx].set(p))
-            elif self.pool.needs_copy(pages[idx]):      # COW
-                dst = None if self.injector.fire("cow_clone") \
-                    else self.pool.alloc()
-                if dst is None:
-                    # the failed alloc's LRU sweep emptied the prefix
-                    # registry; if the page's only co-owner was the
-                    # registry the append is now in-place legal — no
-                    # copy needed. Preempting instead would livelock:
-                    # re-admission recreates the exact same state
-                    # (registered partial last page at refcount 2,
-                    # pool at the validated worst-case fit)
-                    if not self.pool.needs_copy(pages[idx]):
-                        continue
-                    self._preempt(i, preempted)
-                    continue
-                self.stats.cow_copies += 1
-                self.cache = self._copy(self.cache,
-                                        jnp.int32(pages[idx]),
-                                        jnp.int32(dst))
-                self.cache = self.cache._replace(
-                    block_tables=self.cache.block_tables.at[i, idx].set(
-                        dst))
-                self.pool.release(pages[idx])
-                pages[idx] = dst
+            first = pos // self.page_size
+            last = (pos + n_new - 1) // self.page_size
+            for idx in range(first, last + 1):
+                if idx == len(pages):                   # page boundary
+                    p = self.pool.alloc()
+                    if p is None:
+                        self._preempt(i, preempted)
+                        break
+                    pages.append(p)
+                    self.cache = self.cache._replace(
+                        block_tables=self.cache.block_tables.at[
+                            i, idx].set(p))
+                elif self.pool.needs_copy(pages[idx]):  # COW
+                    dst = None if self.injector.fire("cow_clone") \
+                        else self.pool.alloc()
+                    if dst is None:
+                        # the failed alloc's LRU sweep emptied the
+                        # prefix registry; if the page's only co-owner
+                        # was the registry the append is now in-place
+                        # legal — no copy needed. Preempting instead
+                        # would livelock: re-admission recreates the
+                        # exact same state (registered partial last
+                        # page at refcount 2, pool at the validated
+                        # worst-case fit)
+                        if not self.pool.needs_copy(pages[idx]):
+                            continue
+                        self._preempt(i, preempted)
+                        break
+                    self.stats.cow_copies += 1
+                    self.cache = self._copy(self.cache,
+                                            jnp.int32(pages[idx]),
+                                            jnp.int32(dst))
+                    self.cache = self.cache._replace(
+                        block_tables=self.cache.block_tables.at[
+                            i, idx].set(dst))
+                    self.pool.release(pages[idx])
+                    pages[idx] = dst
         return preempted
 
     def _preempt(self, slot: int, preempted: List[int]) -> None:
@@ -457,6 +547,11 @@ class ContinuousBatchingScheduler:
         self._submit_tick: Dict[int, int] = {}
         self._tick_no = 0
         self._tokens_emitted = 0
+        # (B,) base keys × (B, k1) offsets -> (B, k1, 2) per-position
+        # sampling keys for verify ticks: position j of slot b folds in
+        # n_generated[b] + j — the plain stream's key for that token
+        self._fold_grid = jax.jit(jax.vmap(
+            jax.vmap(jax.random.fold_in, (None, 0)), (0, 0)))
 
     def submit(self, request: Request) -> int:
         if self.max_queue is not None \
@@ -473,10 +568,13 @@ class ContinuousBatchingScheduler:
                 f"max_len {self.engine.max_len}")
         # fail fast at submit, not mid-run inside _admit: the prompt
         # must have a bucket rung and (paged) fit the pool even running
-        # alone at its worst-case generated length
+        # alone at its worst-case generated length — plus the verify
+        # step's overshoot (speculative writes can land up to spec_k
+        # rows past the final committed token)
         bucket_for(len(request.prompt), self.engine.buckets)
         self.engine.page_demand(
-            len(request.prompt) + request.max_new_tokens)
+            len(request.prompt) + request.max_new_tokens
+            + self.engine.spec_k)
         rid = self._next_id
         self._next_id += 1
         self._submit_tick[rid] = self._tick_no
@@ -657,6 +755,25 @@ class ContinuousBatchingScheduler:
         self._slots[i] = None
         self.engine.free_slot(i)
 
+    def _draft_all(self) -> List[List[int]]:
+        """One draft per slot (empty for free slots and fired
+        ``draft_exec`` sites — drafting is best-effort, so a fault
+        degrades the slot to plain pace without charging its retry
+        budget)."""
+        drafts: List[List[int]] = []
+        for s in self._slots:
+            if s is None:
+                drafts.append([])
+                continue
+            try:
+                d = self.engine.draft(
+                    tuple(s.request.prompt) + tuple(s.generated))
+            except InjectedFault:
+                self.stats.draft_faults += 1
+                d = []
+            drafts.append([int(t) for t in d])
+        return drafts
+
     def _tick(self) -> None:
         eng = self.engine
         # give every occupied slot an exclusive write target for this
@@ -666,10 +783,21 @@ class ContinuousBatchingScheduler:
         # original stream bit-for-bit)
         positions = {i: s.pos for i, s in enumerate(self._slots)
                      if s is not None}
+        # speculate only when EVERY active slot has spec_k + 1 rows of
+        # headroom (a clamped out-of-range cache write would shift onto
+        # committed rows) and some draft is non-empty; otherwise this
+        # tick is a plain decode step — the k=0 degradation the chaos
+        # tier leans on
+        drafts = self._draft_all() if eng.spec_k > 0 else None
+        spec = bool(drafts is not None and positions
+                    and all(pos + eng.spec_k + 1 <= eng.max_len
+                            for pos in positions.values())
+                    and any(drafts[i] for i in positions))
         # requeue in submission order: appendleft of the newest request
         # first leaves the oldest at the queue front (slot-index order
         # would let a later request resume before an earlier one)
-        preempted = eng.prepare_decode(positions)
+        preempted = eng.prepare_decode(
+            positions, n_new=eng.spec_k + 1 if spec else 1)
         for i in sorted(preempted,
                         key=lambda j: self._slots[j].request_id,
                         reverse=True):
@@ -679,6 +807,9 @@ class ContinuousBatchingScheduler:
             self._slots[i] = None
         occupied = [s for s in self._slots if s is not None]
         if not occupied:
+            return
+        if spec:
+            self._spec_tick(drafts)
             return
         tokens = jnp.asarray(
             [s.generated[-1] if s else 0 for s in self._slots],
@@ -717,6 +848,98 @@ class ContinuousBatchingScheduler:
             self._maybe_evict(i)
         # quarantine AFTER the healthy slots commit, requeueing at the
         # front in submission order (same rule as preemption)
+        for i, err in sorted(
+                quarantined,
+                key=lambda t: self._slots[t[0]].request_id,
+                reverse=True):
+            self._quarantine(i, err)
+
+    def _spec_tick(self, drafts: List[List[int]]) -> None:
+        """Draft → verify → accept: one verify step over k+1 candidate
+        positions per slot, then a host walk that commits the longest
+        prefix of grid samples reproducing the drafts plus the first
+        non-matching sample (1..k+1 tokens per slot). Grid position j
+        samples with ``fold_in(seed, n_generated + j)`` — the PLAIN
+        stream's key for that token — so the committed stream is
+        bit-identical to non-speculative decode (see
+        ``serving.sampling``); acceptance only compresses ticks."""
+        eng = self.engine
+        k1 = eng.spec_k + 1
+        rows = []
+        for i, s in enumerate(self._slots):
+            d = drafts[i][:eng.spec_k]
+            rows.append(([s.generated[-1] if s else 0] + d
+                         + [0] * (eng.spec_k - len(d))))
+        tokens = jnp.asarray(rows, jnp.int32)
+        temps = jnp.asarray(
+            [s.request.temperature if s else 0.0 for s in self._slots],
+            jnp.float32)
+        base = jnp.stack(
+            [jax.random.PRNGKey(s.request.seed) if s
+             else jax.random.PRNGKey(0) for s in self._slots])
+        offs = jnp.asarray(
+            [[(len(s.generated) if s else 0) + j for j in range(k1)]
+             for s in self._slots], jnp.int32)
+        keys = self._fold_grid(base, offs)
+        logits = eng.verify(tokens)
+        finite = np.asarray(eng.finite(logits))            # (B, k1)
+        grid = np.asarray(eng.sample_grid(logits, keys, temps))
+        vocab = eng.cfg.vocab_size
+        counts = [0] * eng.num_slots
+        quarantined: List[Tuple[int, NonFiniteLogits]] = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            draft = drafts[i]
+            committed = accepted = 0
+            for j in range(k1):
+                # the always-on production gates run per committed
+                # position, never on the grid tail beyond the walk —
+                # those rows condition on rejected drafts and are
+                # garbage a plain tick would never have computed
+                if not bool(finite[i, j]):
+                    self.stats.nan_events += 1
+                    quarantined.append((i, NonFiniteLogits(
+                        f"slot {i} (request {slot.request_id}): "
+                        "non-finite verify logits")))
+                    break
+                tok = int(grid[i, j])
+                if not 0 <= tok < vocab:
+                    self.stats.bad_samples += 1
+                    quarantined.append((i, NonFiniteLogits(
+                        f"slot {i} (request {slot.request_id}): "
+                        f"sampled token {tok} outside [0, {vocab})")))
+                    break
+                slot.generated.append(tok)
+                slot.pos += 1
+                self._tokens_emitted += 1
+                committed += 1
+                matched = j < len(draft) and draft[j] == tok
+                if matched:
+                    accepted += 1
+                if tok == self.eos_id or len(slot.generated) \
+                        >= slot.request.max_new_tokens:
+                    break
+                if not matched:
+                    # the non-matching sample IS the committed token
+                    # (the residual-distribution resample; see
+                    # serving.sampling) — the walk ends here
+                    break
+            counts[i] = committed
+            self.stats.tokens_drafted += len(draft)
+            self.stats.tokens_accepted += accepted
+        eng.commit(counts)
+        # a tick that commits m tokens counts m toward deadlines: the
+        # scheduler clock stays in decode-step equivalents across modes
+        extra = max(counts) - 1
+        if extra > 0:
+            self._tick_no += extra
+        qset = {i for i, _ in quarantined}
+        for i, slot in enumerate(self._slots):
+            if slot is not None and i not in qset and counts[i]:
+                self._maybe_evict(i)
+        # quarantine keeps any partially committed (plain-stream
+        # bit-identical) tokens: the requeue resumes from them
         for i, err in sorted(
                 quarantined,
                 key=lambda t: self._slots[t[0]].request_id,

@@ -255,6 +255,207 @@ def test_paged_dense_logits_agree():
     np.testing.assert_allclose(paged, dense, rtol=1e-5, atol=1e-5)
 
 
+# -- speculative verify -----------------------------------------------------
+
+def _seq(cfg, n, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (1, n), 0,
+                              cfg.vocab_size)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_verify_matches_full_forward(k):
+    """The k+1-position verify forward is exact: row j equals the full
+    forward's logits after reading seq[: PROMPT + j + 1] — the verify
+    step is a prefill-shaped continuation, not an approximation."""
+    from apex_tpu.serving import make_verify_fn
+
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 1)
+    prefill = make_prefill_fn(cfg)
+    verify = make_verify_fn(cfg)
+    cache = init_cache(cfg, 2, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    # column 0 = the pending token, columns 1.. = drafts; slot 1 idle
+    # (its rows 0..k take garbage writes the masks never admit)
+    tokens = jnp.concatenate(
+        [seq[:, PROMPT:], jnp.zeros((1, k + 1), jnp.int32)], axis=0)
+    cache, logits = verify(params, cache, tokens)
+    want = _full_logits(params, cfg, seq)[0, PROMPT:]
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    # lengths are committed by the HOST after the accept walk, never by
+    # the verify step itself
+    assert int(cache.lengths[0]) == PROMPT
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_paged_verify_matches_full_forward(k):
+    """Same exactness through the page indirection (page_size 8 with
+    PROMPT 8: the verify window starts ON a page boundary, so
+    prepare_decode's n_new-row allocation is load-bearing)."""
+    from apex_tpu.serving import PagedDecodeEngine
+
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 1)
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=S_MAX,
+                            num_pages=14, page_size=8,
+                            cache_dtype=jnp.float32,
+                            buckets=(8, 16, 32), spec_k=k)
+    eng.prefill(0, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+    assert eng.prepare_decode({0: PROMPT}, n_new=k + 1) == []
+    tokens = jnp.concatenate(
+        [seq[:, PROMPT:], jnp.zeros((1, k + 1), jnp.int32)], axis=0)
+    logits = eng.verify(tokens)
+    want = _full_logits(params, cfg, seq)[0, PROMPT:]
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_verify_rejected_rows_not_observable(paged):
+    """The rollback contract, bitwise: two runs whose first verify step
+    carried DIFFERENT garbage draft tails (all rejected — only the
+    pending token commits) must produce a bit-identical next verify
+    step AND a bit-identical next plain-decode step. Rejected rows are
+    written, but every later mask either re-writes them first (verify:
+    the new window covers the stale range) or never admits them (plain:
+    scores masked at fp32 -inf before softmax) — tolerance here would
+    hide a real leak."""
+    k = 3
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 2)
+
+    def run(garbage):
+        if paged:
+            from apex_tpu.serving import PagedDecodeEngine
+            eng = PagedDecodeEngine(params, cfg, num_slots=1,
+                                    max_len=S_MAX, num_pages=14,
+                                    page_size=8, cache_dtype=jnp.float32,
+                                    buckets=(8, 16, 32), spec_k=k)
+            eng.prefill(0, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+            eng.prepare_decode({0: PROMPT}, n_new=k + 1)
+            bad = jnp.concatenate(
+                [seq[:, PROMPT:PROMPT + 1],
+                 jnp.full((1, k), garbage, jnp.int32)], axis=1)
+            eng.verify(bad)
+            eng.commit([1])  # accept only the pending token
+            eng.prepare_decode({0: PROMPT + 1}, n_new=k + 1)
+            l_verify = eng.verify(seq[:, PROMPT + 1:PROMPT + k + 2])
+            eng.commit([1])
+            eng.prepare_decode({0: PROMPT + 2})
+            l_plain = eng.decode(seq[:, PROMPT + 2],
+                                 jnp.asarray([True]))
+            return np.asarray(l_verify), np.asarray(l_plain)
+        from apex_tpu.serving import make_verify_fn
+        prefill = make_prefill_fn(cfg)
+        verify = make_verify_fn(cfg)
+        decode = make_decode_fn(cfg)
+        cache = init_cache(cfg, 1, S_MAX, jnp.float32)
+        cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                           jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+        bad = jnp.concatenate(
+            [seq[:, PROMPT:PROMPT + 1],
+             jnp.full((1, k), garbage, jnp.int32)], axis=1)
+        cache, _ = verify(params, cache, bad)
+        cache = cache._replace(lengths=cache.lengths + 1)
+        cache, l_verify = verify(params, cache,
+                                 seq[:, PROMPT + 1:PROMPT + k + 2])
+        cache = cache._replace(lengths=cache.lengths + 1)
+        cache, l_plain = decode(params, cache, seq[:, PROMPT + 2],
+                                jnp.asarray([True]))
+        return np.asarray(l_verify), np.asarray(l_plain)
+
+    va, pa = run(3)
+    vb, pb = run(499)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(pa, pb)
+
+
+def test_verify_agrees_with_plain_decode_steps():
+    """Feeding the verify window one token at a time through plain
+    decode must land on the same logits to tight fp32 tolerance (not
+    bitwise: the two are differently shaped reductions — the stream
+    bit-identity contract lives at the sampled-token level, see
+    test_scheduler.py)."""
+    from apex_tpu.serving import make_verify_fn
+
+    k = 3
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 1)
+    plain = np.asarray(_teacher_forced(params, cfg, seq))[1:]
+
+    prefill = make_prefill_fn(cfg)
+    verify = make_verify_fn(cfg)
+    cache = init_cache(cfg, 2, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    tokens = jnp.concatenate(
+        [seq[:, PROMPT:], jnp.zeros((1, k + 1), jnp.int32)], axis=0)
+    _, logits = verify(params, cache, tokens)
+    np.testing.assert_allclose(np.asarray(logits[0]), plain,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tp_verify_matches_unsharded():
+    """tp=2 speculative verify (dense + paged): logits match the
+    unsharded verify step to fp32 tolerance and the greedy accept walk
+    commits the identical token prefix — the TP mesh composes with
+    speculation unchanged."""
+    from apex_tpu.models.gpt import GPTModel
+    from apex_tpu.serving import (
+        PagedDecodeEngine, make_tp_paged_verify_fn, make_tp_verify_fn,
+        make_verify_fn,
+    )
+    from apex_tpu.transformer import parallel_state as ps
+
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    k = 2
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 1)
+    tokens = jnp.concatenate(
+        [seq[:, PROMPT:], jnp.zeros((1, k + 1), jnp.int32)], axis=0)
+    ps.initialize_model_parallel(tensor_model_parallel_size_=2)
+    model = GPTModel(cfg, tp_size=2)
+
+    # dense: one prefilled cache, cloned through both verify paths
+    prefill = make_prefill_fn(cfg)
+    cache = init_cache(cfg, 2, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    clone = jax.tree.map(jnp.copy, cache)
+    _, want = make_verify_fn(cfg)(params, cache, tokens)
+    _, got = make_tp_verify_fn(model)(params, clone, tokens)
+    np.testing.assert_allclose(np.asarray(got[0]),
+                               np.asarray(want[0]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got[0], -1)),
+                                  np.asarray(jnp.argmax(want[0], -1)))
+
+    # paged: engine-built cache (block tables + pool), same contract
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=S_MAX,
+                            num_pages=14, page_size=8,
+                            cache_dtype=jnp.float32,
+                            buckets=(8, 16, 32), spec_k=k)
+    eng.prefill(0, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+    eng.prepare_decode({0: PROMPT}, n_new=k + 1)
+    clone = jax.tree.map(jnp.copy, eng.cache)
+    want = eng.verify(tokens)
+    _, got = make_tp_paged_verify_fn(model)(params, clone, tokens)
+    np.testing.assert_allclose(np.asarray(got[0]),
+                               np.asarray(want[0]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got[0], -1)),
+                                  np.asarray(jnp.argmax(want[0], -1)))
+
+
 def test_init_paged_cache_validates():
     from apex_tpu.serving import init_paged_cache
     from apex_tpu.serving.cache import (

@@ -1,0 +1,64 @@
+"""ngram_draft: the host-side prompt-lookup drafter. Pure function of
+the token history — these are exact-value tests, no device work."""
+
+from apex_tpu.serving import ngram_draft
+
+
+def test_repeating_pattern_continues():
+    # suffix [1, 2] last occurred at index 0; the continuation is
+    # [3, 1, 2] — the draft that makes a period-3 loop free to decode
+    assert ngram_draft([1, 2, 3, 1, 2], 3) == [3, 1, 2]
+
+
+def test_longest_suffix_wins():
+    # the trigram suffix [1, 2, 3] recurs (continuation 9) and so does
+    # the bigram [2, 3] (a later occurrence continues with 5); longer
+    # evidence must win over recency at a shorter length
+    hist = [1, 2, 3, 9, 2, 3, 5, 1, 2, 3]
+    assert ngram_draft(hist, 1) == [9]
+
+
+def test_recency_breaks_ties_within_a_length():
+    # [2, 3] occurs twice with different continuations; the MOST RECENT
+    # earlier occurrence (-> 5) is the draft, not the first (-> 9)
+    hist = [2, 3, 9, 2, 3, 5, 2, 3]
+    assert ngram_draft(hist, 1, max_ngram=2) == [5]
+
+
+def test_terminal_self_match_excluded():
+    # every suffix of [1, 2, 3] occurs only once (at the end): a
+    # drafter that matched the suffix against itself would return
+    # garbage here instead of the honest empty draft
+    assert ngram_draft([1, 2, 3], 3) == []
+
+
+def test_no_recurrence_returns_empty():
+    assert ngram_draft([1, 2, 3, 4, 5, 6], 4) == []
+
+
+def test_short_and_empty_history():
+    assert ngram_draft([], 3) == []
+    assert ngram_draft([7], 3) == []  # nothing before the 1-gram suffix
+
+
+def test_draft_truncated_at_history_end():
+    # the match sits one token from the end: only one continuation
+    # token exists, and the drafter must return the short draft rather
+    # than pad or over-read
+    assert ngram_draft([5, 9, 5], 4) == [9, 5]
+
+
+def test_k_bounds():
+    hist = [1, 2, 3, 1, 2]
+    assert ngram_draft(hist, 0) == []
+    assert ngram_draft(hist, -1) == []
+    assert ngram_draft(hist, 2) == [3, 1]
+
+
+def test_ngram_window_bounds():
+    hist = [1, 2, 3, 1, 2]
+    assert ngram_draft(hist, 3, max_ngram=0) == []
+    assert ngram_draft(hist, 3, min_ngram=0) == []
+    # min_ngram above any recurring length -> empty
+    assert ngram_draft([9, 1, 2, 3, 1, 2, 3], 2, min_ngram=3,
+                       max_ngram=3) == [1, 2]

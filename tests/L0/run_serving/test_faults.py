@@ -208,6 +208,71 @@ def test_sample_fault_at_admission_recovers(model):
     assert sched.outcomes[0].ok and sched.outcomes[0].retries == 1
 
 
+def _spec_engine(model, injector=None, spec_k=3, num_pages=20):
+    cfg, params = model
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                             num_pages=num_pages, page_size=4,
+                             buckets=(16, 32), spec_k=spec_k,
+                             injector=injector)
+
+
+def test_draft_fault_degrades_to_plain_and_recovers(model):
+    """A mid-stream draft fault degrades that slot to an empty draft
+    (an all-empty tick runs plain decode) for the tick — drafting is
+    best-effort, so NO retry budget is charged — and the recovered
+    stream is bit-identical to both the fault-free speculative golden
+    and the never-speculated plain run."""
+    reqs = [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=6),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=6,
+                    temperature=0.8, seed=3)]
+
+    def run(injector=None):
+        return _drive(_spec_engine(model, injector), reqs, audit=True)
+
+    _, golden = run()
+    assert golden == _golden(model, reqs)  # spec == plain, fault-free
+    sched, outs = run(FaultInjector(schedule={"draft_exec": (1, 4)}))
+    assert outs == golden
+    assert sched.stats.draft_faults == 2
+    assert sched.stats.retries == 0
+    assert all(o.ok for o in sched.outcomes.values())
+    # degraded ticks still drafted nothing FOR THE VICTIM only: the
+    # co-tenant kept speculating (drafted counters moved)
+    assert sched.stats.tokens_drafted > 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spec_multi_fault_chaos_is_typed_prefixed_and_replayable(
+        model, seed):
+    """Randomized faults at every site INCLUDING draft_exec against the
+    speculative scheduler: typed outcomes, golden-prefix degradation,
+    bit-for-bit replay — the spec tick must compose with quarantine,
+    preemption and retry exactly like the plain one."""
+    reqs = [Request(prompt=(7, 11, 7, 11), max_new_tokens=5),
+            Request(prompt=(17, 19, 17, 19), max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=(7, 11, 13, 29), max_new_tokens=4),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=6,
+                    temperature=0.7, seed=9)]
+    golden = _golden(model, reqs)
+    rates = {"pool_alloc": 0.1, "cow_clone": 0.2, "prefill_exec": 0.15,
+             "decode_exec": 0.1, "sample": 0.1, "draft_exec": 0.3}
+
+    def chaos_run():
+        eng = _spec_engine(model,
+                           FaultInjector(seed=seed, rates=rates),
+                           num_pages=14)
+        sched, _ = _drive(eng, reqs, audit=True)
+        return sched
+
+    sched = chaos_run()
+    _check_contract(sched, reqs, golden)
+    replay = chaos_run()
+    assert replay.outcomes == sched.outcomes
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts
+
+
 # -- typed terminations ------------------------------------------------------
 
 def test_retry_budget_exhausted_surfaces_typed(model):

@@ -177,8 +177,7 @@ def test_prefill_raises_pool_exhausted_when_out_of_pages():
     """An admission the pool can't cover (even after LRU eviction)
     raises typed ``PoolExhausted`` — carrying need/free/cached — and
     leaks nothing: every transient reference is rolled back so the
-    request can be retried after evictions. ``try_prefill`` keeps the
-    legacy None shim for direct drivers."""
+    request can be retried after evictions."""
     cfg = _cfg()
     params = init_gpt(jax.random.PRNGKey(0), cfg)
     eng = _engine(params, cfg, num_pages=RESERVED_PAGES + 3,
@@ -192,8 +191,9 @@ def test_prefill_raises_pool_exhausted_when_out_of_pages():
     assert exc.value.cached == 0
     assert eng.pool.num_free == free_before  # rollback, no leak
     eng.check_invariants()                   # books balance post-rollback
-    # compat shim: same exhaustion as a None, for direct drivers
-    assert eng.try_prefill(1, [2, 3, 4, 6, 8, 9, 10, 12]) is None
+    # the retry is typed too — and still leak-free
+    with pytest.raises(PoolExhausted):
+        eng.prefill(1, [2, 3, 4, 6, 8, 9, 10, 12])
     assert eng.pool.num_free == free_before
     eng.free_slot(0)
     assert eng.pool.num_free == 3

@@ -182,8 +182,8 @@ def test_paged_preemption_requeues_and_resumes():
     preempted = []
     orig = engine.prepare_decode
 
-    def spy(positions):
-        out = orig(positions)
+    def spy(positions, n_new=1):
+        out = orig(positions, n_new=n_new)
         preempted.extend(out)
         return out
 
@@ -249,7 +249,7 @@ def test_preempted_slots_requeue_in_submission_order():
     sched.submit(Request(prompt=(13, 17), max_new_tokens=8))
     sched._admit()
     assert [s.request_id for s in sched._slots] == [2, 1]
-    engine.prepare_decode = lambda positions: list(positions)
+    engine.prepare_decode = lambda positions, n_new=1: list(positions)
     sched._tick()
     assert [rid for rid, _, _ in sched._queue] == [1, 2]
 
@@ -266,6 +266,125 @@ def test_paged_prefill_rejects_oversized_prompt():
     with pytest.raises(ValueError, match="max_len"):
         engine.prefill(0, tuple(range(2, 11)))
     assert engine.pool.num_free == free_before  # nothing leaked
+
+
+# -- speculative decoding ---------------------------------------------------
+#
+# THE contract: spec_k only changes how many ticks a stream takes,
+# never which tokens it emits. Every test here compares committed
+# token streams with == (exact integer equality) against the plain
+# spec_k=0 run — tolerance would hide a real divergence in the accept
+# rule or the verify step's rollback.
+
+def _spec_requests():
+    # repetitive prompts give the n-gram drafter traction (suffixes
+    # recur, so real accept/reject mixes are exercised, not just the
+    # all-rejected path); the sampled requests pin the
+    # fold_in(seed, n_generated + j) key alignment
+    return [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=8),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=8,
+                    temperature=0.8, seed=3),
+            Request(prompt=(7, 11, 7, 11), max_new_tokens=6,
+                    temperature=0.7, seed=9),
+            Request(prompt=(13, 17, 19), max_new_tokens=5)]
+
+
+def _spec_stats(params, cfg, requests, num_slots, spec_k, paged):
+    if paged:
+        engine = PagedDecodeEngine(params, cfg, num_slots=num_slots,
+                                   max_len=MAX_LEN, num_pages=24,
+                                   page_size=4, buckets=(16, 32),
+                                   spec_k=spec_k)
+    else:
+        engine = DecodeEngine(params, cfg, num_slots=num_slots,
+                              max_len=MAX_LEN, buckets=(16, 32),
+                              spec_k=spec_k)
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS,
+                                        audit=paged)
+    for r in requests:
+        sched.submit(r)
+    return sched.run(), sched.stats
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+@pytest.mark.parametrize("spec_k", [1, 2, 3])
+def test_spec_stream_bit_identical_to_plain(spec_k, paged):
+    """Greedy + seeded-sampled requests through the draft→verify→accept
+    loop: the committed streams equal the plain spec_k=0 streams
+    token-for-token, at every draft depth, on both cache layouts."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _spec_requests()
+    plain, _ = _spec_stats(params, cfg, reqs, 2, 0, paged)
+    spec, stats = _spec_stats(params, cfg, reqs, 2, spec_k, paged)
+    assert spec == plain
+    assert stats.tokens_drafted > 0  # the drafter actually proposed
+    assert stats.tokens_accepted >= 0
+
+
+def test_spec_accepts_make_progress():
+    """On a maximally predictable greedy stream the accept walk must
+    actually commit drafted tokens (acceptance_rate > 0) — otherwise
+    spec mode silently degenerates to plain decode plus overhead."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(7, 11, 7, 11, 7, 11, 7), max_new_tokens=10)]
+    plain, _ = _spec_stats(params, cfg, reqs, 1, 0, True)
+    spec, stats = _spec_stats(params, cfg, reqs, 1, 3, True)
+    assert spec == plain
+    assert stats.tokens_accepted > 0
+    assert 0.0 < stats.acceptance_rate <= 1.0
+
+
+def test_spec_stream_independent_of_slot_placement():
+    """The sampled probe request decodes to the same stream alone and
+    crowded, under spec — keys stay a pure function of
+    (seed, n_generated), never of slot index or batch mix."""
+    cfg = _cfg()
+    params = _params(cfg)
+    probe = Request(prompt=(5, 7, 5, 7, 5), max_new_tokens=6,
+                    temperature=0.8, seed=42)
+    alone, _ = _spec_stats(params, cfg, [probe], 1, 2, True)
+    filler = [Request(prompt=(2, 3, 2, 3), max_new_tokens=6,
+                      temperature=0.9, seed=i) for i in range(3)]
+    crowded, _ = _spec_stats(params, cfg, [probe] + filler, 4, 2, True)
+    assert alone[0] == crowded[0]
+
+
+def test_spec_respects_max_new_tokens_and_eos():
+    """A verify tick can sample EOS or hit max_new_tokens mid-grid —
+    the walk must stop committing exactly where the plain stream
+    stops (never over-commit from an accepted tail)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(7, 11, 7, 11), max_new_tokens=1),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=2),
+            Request(prompt=(13, 17, 13, 17), max_new_tokens=16)]
+    plain, _ = _spec_stats(params, cfg, reqs, 3, 0, True)
+    spec, _ = _spec_stats(params, cfg, reqs, 3, 3, True)
+    assert spec == plain
+    assert len(spec[0]) == 1 and len(spec[1]) <= 2
+
+
+def test_spec_near_max_len_degrades_to_plain():
+    """When any active slot is within spec_k+1 rows of max_len the tick
+    runs plain (the dynamic_update_slice clamp hazard) — streams still
+    finish and match the plain run exactly."""
+    cfg = _cfg()
+    params = _params(cfg)
+    # 5 prompt + 8 new = 13 of max_len 16: the last ticks CANNOT fit a
+    # k=3 verify window, so the guard must kick in
+    def run(spec_k):
+        engine = PagedDecodeEngine(params, cfg, num_slots=1, max_len=16,
+                                   num_pages=24, page_size=4,
+                                   buckets=(8, 16), spec_k=spec_k)
+        sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+        sched.submit(Request(prompt=(7, 11, 7, 11, 7),
+                             max_new_tokens=8))
+        return sched.run()
+
+    assert run(3) == run(0)
 
 
 def test_paged_submit_validates_page_demand():
