@@ -34,6 +34,10 @@ CODES = {
     "APX105": "pallas_call kernel family has no APX102 VMEM registry "
               "config and/or no TraceEntry in the trace registry (new "
               "kernels must register in both trace-time tiers)",
+    "APX106": "quantization contract: scale tensor stored or allocated "
+              "below fp32, dequant-fused matmul without an fp32 "
+              "preferred_element_type, or astype(int8) with no "
+              "round-to-nearest in scope",
     "APX201": "collective sequence diverges across the branches of a "
               "rank-dependent conditional (multi-chip deadlock)",
     "APX202": "collective axis name does not resolve to a "

@@ -136,7 +136,7 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
     """Run all checks over ``paths``; returns (findings, files_checked)."""
-    from apex_tpu.lint import amp_lists, collectives, hygiene, kernels
+    from apex_tpu.lint import amp_lists, collectives, hygiene, kernels, quant
 
     files = collect_files(paths, include_fixtures=include_fixtures)
     findings: List[Finding] = []
@@ -156,7 +156,7 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
             continue
         sources[path] = src
         trees[path] = tree
-        for checker in (kernels, collectives):
+        for checker in (kernels, quant, collectives):
             findings.extend(checker.check_module(tree, path))
 
     findings.extend(hygiene.check_files(trees))

@@ -1,0 +1,243 @@
+"""Quantization-contract checks: APX106.
+
+The int8 inference tier (``apex_tpu.quant`` + the int8 paged KV pool)
+rests on three numeric invariants that a type checker cannot see and a
+tolerance test only catches after the fact:
+
+1. **Scale tensors stay fp32.** A per-channel (or per-page-per-head)
+   scale rounded through bf16 loses ~5 bits of mantissa and biases
+   every dequantized element of its channel the same direction — the
+   error is systematic, not noise, and teacher-forced logit drift
+   explodes. Flags (a) stores into a ``*scale*``-stemmed ref/out that
+   round through ``astype(bf16/f16)``, (b) ``pallas_call`` scratch /
+   ``out_shape`` declarations that allocate a ``*scale*`` operand
+   below fp32.
+
+2. **Dequant accumulators are fp32.** Inside a dequant-fused matmul
+   (any function whose name contains ``w8`` or ``dequant``) every
+   ``dot``/``dot_general``/``matmul`` must pin
+   ``preferred_element_type`` to fp32 (or wider) — the operands are
+   fp32-dequantized in registers, but without the pin XLA may pick a
+   narrower accumulator on bf16-native backends.
+
+3. **int8 stores round to nearest.** ``astype(int8)`` truncates toward
+   zero; round-to-nearest (RTN) is what makes whole-page requant
+   idempotent at a fixed scale (untouched pages stay bit-identical —
+   the paged COW/placement-independence tests rely on it). Flags any
+   ``astype(int8)`` inside a function that contains no explicit
+   rounding call (``round``/``rint``/``nearbyint``).
+
+Like every apxlint check these are conventions over the repo's own
+naming idioms (``X_ref``/``X_out`` kernel params, ``w8_*`` kernel
+names); anything not statically readable is skipped, never guessed at.
+"""
+
+import ast
+from typing import Dict, List, Optional
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.astutil import (
+    attr_chain,
+    call_name,
+    kwarg,
+    static_elements,
+    static_len,
+)
+
+_LOW_PRECISION = {"bfloat16", "float16"}
+_ACCUM_OK = {"float32", "float64"}
+_DOT_NAMES = {"dot", "dot_general", "matmul"}
+_ROUND_NAMES = {"round", "rint", "nearbyint"}
+_DEQUANT_MARKERS = ("w8", "dequant")
+
+
+def _stem(param: str) -> str:
+    for suffix in ("_ref", "_out"):
+        if param.endswith(suffix):
+            return param[: -len(suffix)]
+    return param
+
+
+def _is_scale(param: str) -> bool:
+    return "scale" in _stem(param)
+
+
+def _dtype_name(node: Optional[ast.AST]) -> Optional[str]:
+    """``jnp.float32`` -> "float32"; ``"int8"`` -> "int8"; else None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    chain = attr_chain(node)
+    return chain[-1] if chain else None
+
+
+def _is_low_precision(node: Optional[ast.AST]) -> bool:
+    return _dtype_name(node) in _LOW_PRECISION
+
+
+def _downcasts(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "astype" and n.args
+                and _is_low_precision(n.args[0])):
+            return True
+    return False
+
+
+def _kernel_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call) and call_name(node) == "partial":
+        if node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+    return None
+
+
+def check_module(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    defs: Dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            defs.setdefault(n.name, n)
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and call_name(node) == "pallas_call" and node.args):
+            kname = _kernel_name(node.args[0])
+            kernel = defs.get(kname) if kname else None
+            if kernel is not None:
+                findings.extend(_check_scale_decls(node, kernel, path))
+
+    findings.extend(_check_scale_stores(tree, path))
+    findings.extend(_check_functions(tree, path))
+    return findings
+
+
+def _check_scale_decls(node: ast.Call, kernel: ast.FunctionDef,
+                       path: str) -> List[Finding]:
+    """Rule 1(b): scale operands of a pallas_call declared below fp32.
+
+    Same positional param mapping as APX101/103: inputs are the first
+    ``len(in_specs)`` kernel params, outputs next, scratch last."""
+    n_in = static_len(kwarg(node, "in_specs"))
+    n_out = static_len(kwarg(node, "out_specs"))
+    params = [a.arg for a in kernel.args.posonlyargs + kernel.args.args]
+    if n_in is None:
+        return []
+    if n_out is None:
+        if kwarg(node, "scratch_shapes") is not None:
+            return []
+        n_out = len(params) - n_in
+    if n_out < 0 or len(params) < n_in + n_out:
+        return []
+
+    out_params = params[n_in:n_in + n_out]
+    scratch_params = params[n_in + n_out:]
+
+    findings = []
+    scratch = static_elements(kwarg(node, "scratch_shapes")) or []
+    for p, elem in zip(scratch_params, scratch):
+        if not _is_scale(p):
+            continue
+        if (isinstance(elem, ast.Call) and len(elem.args) >= 2
+                and _is_low_precision(elem.args[1])):
+            findings.append(Finding(
+                "APX106", path, elem.lineno,
+                f"scale scratch '{p}' allocated in reduced precision — "
+                "quantization scales must stay fp32"))
+    outs = static_elements(kwarg(node, "out_shape")) or []
+    for p, elem in zip(out_params, outs):
+        if not _is_scale(p):
+            continue
+        if (isinstance(elem, ast.Call) and len(elem.args) >= 2
+                and _is_low_precision(elem.args[1])):
+            findings.append(Finding(
+                "APX106", path, elem.lineno,
+                f"scale output '{p}' declared in reduced precision — "
+                "quantization scales must stay fp32"))
+    return findings
+
+
+def _check_scale_stores(tree: ast.Module, path: str) -> List[Finding]:
+    """Rule 1(a): ``scale_ref[...] = (...).astype(bf16)`` anywhere —
+    scale refs are unambiguous by naming convention, no call-site
+    mapping needed."""
+    findings = []
+    seen = set()
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)):
+                continue
+            name = t.value.id
+            if not name.endswith(("_ref", "_out")):
+                continue
+            if not _is_scale(name):
+                continue
+            if _downcasts(node.value) and node.lineno not in seen:
+                seen.add(node.lineno)
+                findings.append(Finding(
+                    "APX106", path, node.lineno,
+                    f"store into scale ref '{name}' rounds through a "
+                    "reduced-precision astype — per-channel scales must "
+                    "stay fp32"))
+    return findings
+
+
+def _check_functions(tree: ast.Module, path: str) -> List[Finding]:
+    """Rules 2 and 3, both scoped to the innermost enclosing function."""
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, fn: Optional[ast.FunctionDef],
+              fn_rounds: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                visit(child, child, _has_round(child))
+                continue
+            if isinstance(child, ast.Call):
+                findings.extend(_check_call(child, fn, fn_rounds, path))
+            visit(child, fn, fn_rounds)
+
+    visit(tree, None, False)
+    return findings
+
+
+def _has_round(fn: ast.FunctionDef) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and call_name(n) in _ROUND_NAMES:
+            return True
+    return False
+
+
+def _check_call(node: ast.Call, fn: Optional[ast.FunctionDef],
+                fn_rounds: bool, path: str) -> List[Finding]:
+    name = call_name(node)
+    findings = []
+    # Rule 2: dot inside a dequant-fused body must pin fp32 accumulation
+    if (fn is not None and name in _DOT_NAMES
+            and any(m in fn.name for m in _DEQUANT_MARKERS)):
+        pet = _dtype_name(kwarg(node, "preferred_element_type"))
+        if pet not in _ACCUM_OK:
+            what = (f"preferred_element_type={pet}" if pet
+                    else "no preferred_element_type")
+            findings.append(Finding(
+                "APX106", path, node.lineno,
+                f"{name} in dequant-fused '{fn.name}' has {what} — "
+                "int8 dequant matmuls must accumulate in fp32"))
+    # Rule 3: astype(int8) without an explicit round in the same function
+    if (fn is not None and not fn_rounds and name == "astype"
+            and node.args and _dtype_name(node.args[0]) in ("int8",)):
+        findings.append(Finding(
+            "APX106", path, node.lineno,
+            f"astype(int8) in '{fn.name}' with no rounding call in "
+            "scope — int8 quantization must round to nearest "
+            "(truncation breaks requant idempotence)"))
+    return findings

@@ -154,6 +154,40 @@ def _gpt_reference():
             "paged_kv_cache": paged_cache_partition_specs(kv_cache_rules())}
 
 
+def _gpt_quant_trees():
+    """The weight-only int8 tree (same kernel paths, sibling fp32
+    scales) + the int8 page pool with its per-page-per-head scales —
+    registering both keeps every gpt_quant_rules scale rule live for
+    APX701 and the derived specs APX702-checked. gpt_tiny() default
+    (learned positions) so the position-embedding rule stays live."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.quant.params import quantize_params
+    from apex_tpu.serving.cache import init_paged_cache
+
+    cfg = gpt_tiny()
+    params = quantize_params(jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0)))
+    paged = jax.eval_shape(ft.partial(
+        init_paged_cache, cfg, 2, 32, 6, 16, jnp.int8))
+    return {"params": params, "paged_kv_cache": paged}
+
+
+def _gpt_quant_reference():
+    from apex_tpu.models.gpt import gpt_tiny
+    from apex_tpu.partition import kv_cache_quant_rules
+    from apex_tpu.quant.params import quant_partition_specs
+    from apex_tpu.serving.cache import paged_cache_partition_specs
+
+    return {"params": quant_partition_specs(gpt_tiny()),
+            "paged_kv_cache": paged_cache_partition_specs(
+                kv_cache_quant_rules(), quantized=True)}
+
+
 def _bert_trees():
     import jax
 
@@ -177,7 +211,7 @@ def _bert_reference():
 
 
 def repo_entries() -> List[ShardedEntry]:
-    from apex_tpu.partition import bert_rules, gpt_rules
+    from apex_tpu.partition import bert_rules, gpt_quant_rules, gpt_rules
 
     return [
         ShardedEntry(
@@ -186,6 +220,15 @@ def repo_entries() -> List[ShardedEntry]:
             reference_specs=_gpt_reference,
             optimizer_families=("m", "v", "master"),
             kv_cache_tree="kv_cache",
+            qkv_kernel_re=r"layers/qkv/kernel"),
+        # quantized tier: no optimizer families (int8 trees are
+        # inference-only); the kv consistency check re-runs against the
+        # int8 pool so its head axis stays pinned to the qkv tp axis
+        ShardedEntry(
+            "gpt_tiny_quant_rules", "apex_tpu.partition.tables",
+            rules=gpt_quant_rules, trees=_gpt_quant_trees,
+            reference_specs=_gpt_quant_reference,
+            kv_cache_tree="paged_kv_cache",
             qkv_kernel_re=r"layers/qkv/kernel"),
         ShardedEntry(
             "bert_tiny_rules", "apex_tpu.partition.tables",

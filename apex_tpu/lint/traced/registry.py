@@ -762,6 +762,130 @@ def _spec_verify_step_medium_ragged_entry():
     return build
 
 
+def _w8_matmul_entry():
+    """The dequant-fused int8 matmul family (column/row apply + the
+    output-channel-major logits head) traced standalone — APX501 proves
+    the fp32 accumulation survives into the jaxpr, APX503 that the
+    register dequant never materializes a blown-up fp32 weight copy."""
+    def build():
+        from apex_tpu.quant.kernels import w8_matmul, w8_matmul_nk
+
+        def fn(x, wq, scale, bias, tq, tscale):
+            h = w8_matmul(x, wq, scale, bias, out_dtype=x.dtype)
+            return w8_matmul_nk(h, tq, tscale)
+
+        return fn, (_sds((8, 256), "bfloat16"),
+                    _sds((256, 512), "int8"), _sds((512,), "float32"),
+                    _sds((512,), "float32"),
+                    _sds((1024, 512), "int8"), _sds((1024,), "float32"))
+
+    return build
+
+
+def _quant_paged_serving_args(cfg, num_slots=2, max_len=32, num_pages=6,
+                              page_size=16):
+    """Weight-only int8 params (same tree paths, int8 kernels + fp32
+    scales) over an int8 page pool with per-page-per-head scales."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import init_gpt
+    from apex_tpu.quant.params import quantize_params
+    from apex_tpu.serving.cache import init_paged_cache
+
+    params = quantize_params(jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0)))
+    cache = jax.eval_shape(ft.partial(
+        init_paged_cache, cfg, num_slots, max_len, num_pages, page_size,
+        jnp.int8))
+    return params, cache
+
+
+def _quant_paged_step_entry(which):
+    """w8 + kv8 paged serving steps: the int8 pool donates SIX leaves
+    (pool k/v, lengths, block tables, k/v scales) — min_alias_pairs=6
+    pins the widened donation."""
+    def build():
+        from apex_tpu.serving.decode import (
+            make_paged_decode_fn, make_paged_prefill_fn,
+            make_paged_verify_fn,
+        )
+
+        cfg = _serving_cfg()
+        params, cache = _quant_paged_serving_args(cfg)
+        if which == "prefill":
+            fn = make_paged_prefill_fn(cfg, quantized=True)
+            return fn, (params, cache, _sds((1, 16), "int32"),
+                        _sds((16,), "int32"), _sds((), "int32"),
+                        _sds((1,), "int32"), _sds((2,), "int32"))
+        if which == "verify":
+            fn = make_paged_verify_fn(cfg, quantized=True)
+            return fn, (params, cache, _sds((2, 4), "int32"))
+        fn = make_paged_decode_fn(cfg, quantized=True)
+        return fn, (params, cache, _sds((2,), "int32"),
+                    _sds((2,), "bool"))
+
+    return build
+
+
+def _w8_decode_step_tp2_entry():
+    """Dense-cache decode under tp2 with int8 weights: the quantized
+    tree shards by ``quant_partition_specs`` (scale specs derived from
+    the bf16 table), the schedule check pins the collective order of
+    the dequant-fused column/row/logits applies."""
+    def build():
+        import jax
+
+        from apex_tpu.models.gpt import GPTModel, init_gpt
+        from apex_tpu.quant.params import quantize_params
+        from apex_tpu.serving.decode import make_tp_decode_fn
+
+        cfg = _serving_cfg()
+        params = quantize_params(jax.eval_shape(
+            lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0)))
+        _, cache = _serving_args(cfg)
+        fn = make_tp_decode_fn(GPTModel(cfg, tp_size=2), quantized=True)
+        return fn, (params, cache, _sds((2,), "int32"), _sds((2,), "bool"))
+
+    return build
+
+
+def _quant_paged_decode_medium_ragged_entry():
+    """The r12 quantized twin of the ragged medium paged decode: int8
+    params (fp32 scales) + int8 page pool at the identical ladder —
+    its budgets.json row pins the halved byte claim (≤ 0.95 GB/step vs
+    1.68 GB bf16, BASELINE.md r12). Cost-tier only."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig, init_gpt
+        from apex_tpu.quant.params import quantize_params
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.decode import make_paged_decode_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        params = quantize_params(jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16),
+            jax.random.PRNGKey(0)))
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page,
+            jnp.int8))
+        fn = make_paged_decode_fn(cfg, quantized=True)
+        return fn, (params, cache, _sds((slots,), "int32"),
+                    _sds((slots,), "bool"))
+
+    return build
+
+
 def _decode_step_medium_entry():
     """The BASELINE.md r8 roofline shape: gpt_medium-class decode, bf16
     params, 32 slots parked at depth 512 (the steady-state mid-cache
@@ -1073,6 +1197,35 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_spec_verify_step_medium_ragged",
                    "apex_tpu.serving.decode",
                    _spec_verify_step_medium_ragged_entry(), checks=()),
+        # int8 tier: the standalone dequant-fused matmuls, the w8+kv8
+        # paged serving steps (6 donated cache leaves — pool k/v,
+        # lengths, block tables, k/v scales), a tp2 dense-decode with
+        # the quantized tree sharded by quant_partition_specs, and the
+        # r12 cost anchor at the ragged medium shape
+        TraceEntry("w8_matmul_fused", "apex_tpu.quant.kernels",
+                   _w8_matmul_entry()),
+        TraceEntry("gpt_paged_prefill_step_w8kv8",
+                   "apex_tpu.serving.decode",
+                   _quant_paged_step_entry("prefill"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=6),
+        TraceEntry("gpt_paged_decode_step_w8kv8",
+                   "apex_tpu.serving.decode",
+                   _quant_paged_step_entry("decode"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=6),
+        TraceEntry("gpt_spec_verify_step_w8kv8",
+                   "apex_tpu.serving.decode",
+                   _quant_paged_step_entry("verify"),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=6),
+        TraceEntry("gpt_decode_step_w8_tp2", "apex_tpu.serving.decode",
+                   _w8_decode_step_tp2_entry(),
+                   checks=("precision", "memory", "schedule", "aliases"),
+                   mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=3),
+        TraceEntry("gpt_paged_decode_step_medium_ragged_w8kv8",
+                   "apex_tpu.serving.decode",
+                   _quant_paged_decode_medium_ragged_entry(), checks=()),
         TraceEntry("fused_softmax_fwd_bwd",
                    "apex_tpu.transformer.functional.fused_softmax",
                    _fused_softmax_entry()),

@@ -366,6 +366,28 @@ def _bottleneck_cfg():
     return build
 
 
+def _w8_matmul_cfg():
+    """The dequant-fused int8 matmul family at serving-like shapes: a
+    column/row-style ``w8_matmul`` (K x N weight, per-N scale, bias)
+    chained into the output-channel-major logits head ``w8_matmul_nk``
+    (V x h table, per-V scale). The N grid streams one (K, block_n)
+    int8 tile + its fp32 dequant in registers — the resident blocks are
+    what the budget prices."""
+    def build():
+        from apex_tpu.quant.kernels import w8_matmul, w8_matmul_nk
+
+        def fn(x, wq, scale, bias, tq, tscale):
+            h = w8_matmul(x, wq, scale, bias, out_dtype=x.dtype)
+            return w8_matmul_nk(h, tq, tscale)
+
+        return fn, (_sds((32, 1024), "bfloat16"),
+                    _sds((1024, 4096), "int8"), _sds((4096,), "float32"),
+                    _sds((4096,), "float32"),
+                    _sds((50304, 4096), "int8"), _sds((50304,), "float32"))
+
+    return build
+
+
 def _paged_serving_cfg(which):
     """Paged serving steps under the recorder: prefill runs flash
     attention over the prompt bucket (its pallas blocks are what the
@@ -430,6 +452,8 @@ def repo_configs() -> List[Config]:
     cfgs.append(Config("bottleneck_spatial_cp2",
                        "apex_tpu.contrib.bottleneck.bottleneck",
                        _bottleneck_cfg()))
+    cfgs.append(Config("w8_matmul_suite", "apex_tpu.quant.kernels",
+                       _w8_matmul_cfg()))
     cfgs.append(Config("gpt_paged_prefill_step", "apex_tpu.serving.decode",
                        _paged_serving_cfg("prefill")))
     cfgs.append(Config("gpt_paged_decode_step", "apex_tpu.serving.decode",

@@ -567,6 +567,156 @@ def _block_verify_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
     return x + mlp, k_pages, v_pages
 
 
+# ---------------------------------------------------------------------------
+# int8-quantized paged attention: RMW whole-page requant on write,
+# dequant inside the gather
+# ---------------------------------------------------------------------------
+
+def _q8_page_insert(pool, scale, pages, rows, new_row):
+    """Insert ``new_row`` (b, nh, hd) fp32 into the int8 page ``pages``
+    of each slot at row ``rows`` by a whole-page READ-MODIFY-WRITE
+    requant: gather page + scale, dequantize, set the exact new row,
+    recompute the per-head amax scale over the whole page, round-requant
+    and scatter page + scale back.
+
+    Whole-page RMW is the correctness-bearing choice: quantizing only
+    the new row against a RUNNING scale would silently corrupt history
+    rows quantized at the old scale. Re-quantizing existing rows at a
+    fixed scale is round-to-nearest idempotent, so untouched-amax pages
+    come back bit-identical; an amax-raising row re-rounds the history
+    at the new scale, which the teacher-forced tolerance gate covers.
+    Duplicate scatter targets only arise when several inactive slots
+    park on SCRATCH_PAGE — never attended, and a 0-or-positive scale
+    always dequantizes finite, so the nondeterminism can't escape."""
+    from apex_tpu.quant.kernels import kv_dequantize, kv_quantize
+
+    b = pages.shape[0]
+    tile = kv_dequantize(pool[pages], scale[pages])    # (b, nh, page, hd)
+    tile = tile.at[jnp.arange(b), :, rows].set(new_row)
+    nq, ns = kv_quantize(tile)
+    return pool.at[pages].set(nq), scale.at[pages].set(ns)
+
+
+def _q8_gather(pool, scale, block_tables, b, hd):
+    """Dequantized (b, nh, S, hd) fp32 view of each slot's table row."""
+    from apex_tpu.quant.kernels import kv_dequantize
+
+    g = kv_dequantize(pool[block_tables], scale[block_tables])
+    g = g.transpose(0, 2, 1, 3, 4)
+    return g.reshape(b, g.shape[1], g.shape[2] * g.shape[3], hd)
+
+
+def _paged_decode_attention_q8(q_k_v, k_pages, v_pages, k_scale, v_scale,
+                               block_tables, pos, cfg: GPTConfig,
+                               rope_freqs):
+    """:func:`_paged_decode_attention` over an INT8 page pool with
+    per-page-per-head fp32 scales. Same write-then-attend and exact-zero
+    masking contracts; the write is the whole-page RMW requant of
+    :func:`_q8_page_insert` and the gather dequantizes against the
+    scatter-updated scales, so the attended history is exactly what the
+    pool stores. Placement independence survives: the RMW is a pure
+    function of page content, and masked probabilities are exactly
+    zero."""
+    b = q_k_v.shape[0]
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, 1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+    logical = jnp.clip(pos // page_size, 0, block_tables.shape[1] - 1)
+    pages = jnp.take_along_axis(block_tables, logical[:, None], 1)[:, 0]
+    rows = pos % page_size
+    k_pages, k_scale = _q8_page_insert(
+        k_pages, k_scale, pages, rows, k[:, :, 0].astype(jnp.float32))
+    v_pages, v_scale = _q8_page_insert(
+        v_pages, v_scale, pages, rows, v[:, :, 0].astype(jnp.float32))
+    kg = _q8_gather(k_pages, k_scale, block_tables, b, hd)
+    vg = _q8_gather(v_pages, v_scale, block_tables, b, hd)
+    s_max = kg.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg) / math.sqrt(hd)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= pos[:, None, None, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs, vg).astype(q_k_v.dtype)
+    return (ctx.transpose(0, 2, 1, 3).reshape(b, 1, -1),
+            k_pages, v_pages, k_scale, v_scale)
+
+
+def _block_decode_paged_q8(lp, x, k_pages, v_pages, k_scale, v_scale,
+                           block_tables, pos, cfg, rope_freqs,
+                           qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_decode_paged` over the int8 pool + scales."""
+    att, k_pages, v_pages, k_scale, v_scale = _paged_decode_attention_q8(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, k_scale, v_scale, block_tables, pos, cfg,
+        rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages, k_scale, v_scale
+
+
+def _paged_verify_attention_q8(q_k_v, k_pages, v_pages, k_scale, v_scale,
+                               block_tables, pos, cfg: GPTConfig,
+                               rope_freqs):
+    """:func:`_paged_verify_attention` over the int8 pool: k1 unrolled
+    whole-page RMW requants (consecutive candidates re-read the latest
+    page state, so same-page candidates compose), then the dequantized
+    gather with the per-query ``s <= pos + j`` masks. NOTE: the RMW can
+    re-scale a page even for candidates the host later rejects, so a
+    kv8 spec stream is gated on the teacher-forced TOLERANCE, not
+    bit-identity — the exact Leviathan-accept bit-identity claim is for
+    int8 WEIGHTS over a bf16 cache (see docs/source/quantization.rst).
+    """
+    b, k1, _ = q_k_v.shape
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, k1, hd)
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=pos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=pos)
+    for j in range(k1):
+        p = pos + j
+        logical = jnp.clip(p // page_size, 0, block_tables.shape[1] - 1)
+        pages = jnp.take_along_axis(
+            block_tables, logical[:, None], 1)[:, 0]
+        rows = p % page_size
+        k_pages, k_scale = _q8_page_insert(
+            k_pages, k_scale, pages, rows, k[:, :, j].astype(jnp.float32))
+        v_pages, v_scale = _q8_page_insert(
+            v_pages, v_scale, pages, rows, v[:, :, j].astype(jnp.float32))
+    kg = _q8_gather(k_pages, k_scale, block_tables, b, hd)
+    vg = _q8_gather(v_pages, v_scale, block_tables, b, hd)
+    s_max = kg.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg) / math.sqrt(hd)
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]        # (b, k1)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= qpos[:, None, :, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs, vg).astype(q_k_v.dtype)
+    return (ctx.transpose(0, 2, 1, 3).reshape(b, k1, -1),
+            k_pages, v_pages, k_scale, v_scale)
+
+
+def _block_verify_paged_q8(lp, x, k_pages, v_pages, k_scale, v_scale,
+                           block_tables, pos, cfg, rope_freqs,
+                           qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_verify_paged` over the int8 pool + scales."""
+    att, k_pages, v_pages, k_scale, v_scale = _paged_verify_attention_q8(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, k_scale, v_scale, block_tables, pos, cfg,
+        rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages, k_scale, v_scale
+
+
 def _maybe_dropout(x, rate, rng, salt):
     if rng is None or rate <= 0:
         return x

@@ -22,11 +22,19 @@ from apex_tpu.partition.rules import (
     tree_path_name,
     tree_paths,
 )
-from apex_tpu.partition.tables import bert_rules, gpt_rules, kv_cache_rules
+from apex_tpu.partition.tables import (
+    bert_rules,
+    gpt_quant_rules,
+    gpt_rules,
+    kv_cache_quant_rules,
+    kv_cache_rules,
+)
 
 __all__ = [
     "bert_rules",
+    "gpt_quant_rules",
     "gpt_rules",
+    "kv_cache_quant_rules",
     "kv_cache_rules",
     "make_mesh",
     "make_shard_and_gather_fns",

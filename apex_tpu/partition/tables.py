@@ -49,6 +49,44 @@ def kv_cache_rules():
     return _KV_CACHE_RULES
 
 
+def kv_cache_quant_rules():
+    """KV-cache rules for the INT8 paged pool: the base rules plus the
+    per-page-per-head fp32 scales ``(L, pages, heads)`` — heads (axis
+    2, same as the pool's) shard over ``model``, so each rank's scale
+    shard dequantizes exactly its local heads' pages."""
+    return _KV_CACHE_RULES + (
+        (r"(^|/)(k|v)_scale$", P(None, None, ps.TENSOR_AXIS)),
+    )
+
+
+def gpt_quant_rules():
+    """Rule table for the weight-only int8 GPT tree
+    (``apex_tpu.quant.quantize_params``) plus the int8 paged cache.
+    Kernel leaves keep their bf16 paths and specs (int8 swaps the
+    dtype, never the layout); each ``scale`` rule is the kernel's spec
+    with the CONTRACTED axis dropped — Column (qkv/fc1) scales follow
+    their output channels onto ``model`` like the bias, Row (out/fc2)
+    scales replicate, the word-table scale rides the vocab shard.
+    Overlap-free against APX701 like the base table (the scale paths
+    end differently from every kernel/bias path)."""
+    t = ps.TENSOR_AXIS
+    return (
+        ("embedding/word/embedding", P(t, None)),
+        ("embedding/word/scale", P(t)),
+        ("embedding/position/embedding", P()),
+        ("layers/(ln1|ln2)/(weight|bias)", P(None)),
+        ("layers/qkv/kernel", P(None, None, t)),
+        ("layers/qkv/(bias|scale)", P(None, t)),
+        ("layers/out/kernel", P(None, t, None)),
+        ("layers/out/(bias|scale)", P(None)),
+        ("layers/fc1/kernel", P(None, None, t)),
+        ("layers/fc1/(bias|scale)", P(None, t)),
+        ("layers/fc2/kernel", P(None, t, None)),
+        ("layers/fc2/(bias|scale)", P(None)),
+        ("final_ln/(weight|bias)", P()),
+    ) + kv_cache_quant_rules()
+
+
 def gpt_rules():
     """Rule table for the GPT param tree (``models.gpt.init_gpt``) plus
     the serving KV cache. First match wins; table is overlap-free."""

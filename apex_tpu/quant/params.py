@@ -1,0 +1,129 @@
+"""Weight-only int8 parameter trees: quantize once, shard like bf16.
+
+``quantize_params`` rewrites a GPT parameter tree in place of layout:
+every matmul kernel (the four per-layer linears plus the tied word
+table) becomes an int8 leaf AT THE SAME PATH with a sibling ``scale``
+leaf — per-output-channel symmetric fp32 scales, contraction axis
+reduced away. Keeping the kernel paths unchanged is what makes the
+partition rule tables carry over: ``layers/qkv/kernel`` still matches
+``layers/qkv/kernel``, and the scale specs are DERIVED from the same
+table by dropping the contracted-axis entry
+(:func:`apex_tpu.partition.tables.gpt_quant_rules`), so a quantized
+tree shards identically to its bf16 twin — APX701 verifies the
+quantized table against registered quantized trees, APX703 the
+shard_map agreement.
+
+Scale layout (the contraction axis is what the dot reduces over, so the
+per-OUTPUT-channel scale survives as one fp32 per column):
+
+====================  ==============  ===========  ==============
+leaf                  kernel shape    contraction  scale shape
+====================  ==============  ===========  ==============
+layers/*/kernel       (L, K, N)       axis -2      (L, N)
+embedding/word        (V, h)          axis -1      (V,)
+====================  ==============  ===========  ==============
+
+Biases, layer norms and the learned position table stay untouched —
+they are O(h) reads, and the O2 lesson applies: keep the cheap
+high-precision master where it costs nothing.
+"""
+
+import re
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# path-regex -> contraction axis of the dot that consumes the leaf.
+# layers/* kernels carry the leading stacked-L dim, hence -2 (the K of
+# (L, K, N)); the tied word table contracts its hidden dim both as the
+# logits head (hidden @ table.T) and, symmetrically, row-dequants on
+# embed lookup.
+_QUANT_AXES = (
+    (r"(^|/)embedding/word/embedding$", -1),
+    (r"(^|/)layers/(qkv|out|fc1|fc2)/kernel$", -2),
+)
+
+
+def quantize_tensor(w, axis: int):
+    """Per-output-channel symmetric int8: amax over the contraction
+    ``axis``, round-to-nearest, fp32 scales. Returns ``(q int8, scale
+    fp32)`` with ``scale.shape = w.shape`` minus ``axis``. Zero
+    channels keep scale 0 and quantize to exact zeros."""
+    fw = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(fw), axis=axis)
+    scale = (amax / 127.0).astype(jnp.float32)
+    safe = jnp.expand_dims(jnp.where(scale > 0, scale, 1.0), axis)
+    q = jnp.clip(jnp.round(fw / safe), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_tensor(q, scale, axis: int, dtype=jnp.float32):
+    """Inverse of :func:`quantize_tensor` (up to the rounding step)."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale.astype(jnp.float32), axis)).astype(
+        dtype)
+
+
+def _quant_axis(path: str):
+    for pat, axis in _QUANT_AXES:
+        if re.search(pat, path):
+            return axis
+    return None
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """GPT param tree -> weight-only int8 tree (kernel leaves int8 at
+    their original paths + sibling fp32 ``scale`` leaves; everything
+    else passed through untouched). Works on concrete arrays and on
+    ``ShapeDtypeStruct`` trees alike (abstract trees take the
+    eval_shape path, for the lint registries)."""
+
+    def rewrite(subtree, prefix):
+        if not isinstance(subtree, dict):
+            return subtree
+        out = {}
+        for name, child in subtree.items():
+            path = f"{prefix}/{name}" if prefix else name
+            axis = _quant_axis(path) if not isinstance(child, dict) \
+                else None
+            if axis is not None:
+                if isinstance(child, jax.ShapeDtypeStruct):
+                    q, scale = jax.eval_shape(
+                        lambda w, a=axis: quantize_tensor(w, a), child)
+                else:
+                    q, scale = quantize_tensor(child, axis)
+                out[name] = q
+                out["scale"] = scale
+            else:
+                out[name] = rewrite(child, path)
+        return out
+
+    return rewrite(params, "")
+
+
+def is_quantized_tree(params: Dict[str, Any]) -> bool:
+    """True when ``params`` carries the weight-only int8 layout (the
+    engines auto-detect which dense/logits impls to build)."""
+    word = params.get("embedding", {}).get("word", {})
+    return "scale" in word
+
+
+def quant_partition_specs(cfg) -> Dict[str, Any]:
+    """PartitionSpecs for a quantized tree: the bf16 specs with each
+    scale's spec derived by dropping the contracted-axis entry —
+    Column (qkv/fc1) scales shard like their bias ``P(None, t)``, Row
+    (out/fc2) scales replicate (their output dim is unsharded), the
+    word-table scale rides the vocab shard ``P(t)``."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.models.gpt import gpt_partition_specs
+    from apex_tpu.transformer import parallel_state as ps
+
+    t = ps.TENSOR_AXIS
+    specs = gpt_partition_specs(cfg)
+    specs["embedding"]["word"]["scale"] = P(t)
+    for name, spec in (("qkv", P(None, t)), ("fc1", P(None, t)),
+                       ("out", P(None)), ("fc2", P(None))):
+        specs["layers"][name] = dict(specs["layers"][name], scale=spec)
+    return specs

@@ -24,7 +24,7 @@ fp32 is for parity tests. Under TP the head axis (2) shards over the
 the head-major qkv column shard.
 """
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -98,11 +98,25 @@ class PagedKVCache(NamedTuple):
     (:class:`apex_tpu.serving.paging.PagePool`) owns which pages are
     live, shared (prefix caching) or free. Heads (axis 2) still shard
     over ``model`` under TP; lengths and block tables are replicated.
+
+    ``kv_dtype=int8`` mode: the pool stores round-to-nearest symmetric
+    int8 with PER-PAGE-PER-HEAD fp32 scales in the trailing
+    ``k_scale``/``v_scale`` leaves (``(L, num_pages, num_heads)``,
+    amax/127 of each head's page — ``apex_tpu.quant.kv_quantize``).
+    The scales ride the same donated cache tuple as the block tables
+    (6 alias pairs instead of 4, pinned by APX512), shard their head
+    axis over ``model`` like the pool, and are cloned together with
+    their pages on copy-on-write. bf16/fp32 caches leave both fields
+    ``None`` — an optional trailing NamedTuple field vanishes from the
+    pytree, so every existing 4-leaf construction and donation site is
+    unchanged.
     """
     k: jax.Array             # (L, num_pages, num_heads, page_size, hd)
     v: jax.Array             # (L, num_pages, num_heads, page_size, hd)
     lengths: jax.Array       # (num_slots,) int32, valid positions
     block_tables: jax.Array  # (num_slots, max_pages) int32 page ids
+    k_scale: Optional[jax.Array] = None  # (L, num_pages, num_heads) f32
+    v_scale: Optional[jax.Array] = None  # (L, num_pages, num_heads) f32
 
 
 def max_pages_per_slot(max_len: int, page_size: int) -> int:
@@ -131,6 +145,17 @@ def init_paged_cache(cfg: GPTConfig, num_slots: int, max_len: int,
              cfg.head_dim)
     bt = jnp.full((num_slots, max_pages_per_slot(max_len, page_size)),
                   SCRATCH_PAGE, jnp.int32)
+    if jnp.dtype(dtype) == jnp.int8:
+        # quantized pool: zero int8 pages + zero fp32 scales (a
+        # 0-scale page dequantizes to exact zeros, so NULL stays
+        # pristine before its first real write)
+        sscale = (cfg.num_layers, num_pages, cfg.num_heads)
+        return PagedKVCache(k=jnp.zeros(shape, jnp.int8),
+                            v=jnp.zeros(shape, jnp.int8),
+                            lengths=jnp.zeros((num_slots,), jnp.int32),
+                            block_tables=bt,
+                            k_scale=jnp.zeros(sscale, jnp.float32),
+                            v_scale=jnp.zeros(sscale, jnp.float32))
     return PagedKVCache(k=jnp.zeros(shape, dtype),
                         v=jnp.zeros(shape, dtype),
                         lengths=jnp.zeros((num_slots,), jnp.int32),
@@ -173,18 +198,31 @@ def audit_block_tables(block_tables, slot_pages) -> bool:
     return True
 
 
-def paged_cache_partition_specs(rules=None) -> PagedKVCache:
+def paged_cache_partition_specs(rules=None,
+                                quantized: bool = False) -> PagedKVCache:
     """Same table-derived TP layout as :func:`cache_partition_specs`:
     the pool's head axis (still axis 2) shards over ``model``; lengths
     AND block tables are replicated — every rank walks the same
-    logical-to-physical mapping over its local heads."""
+    logical-to-physical mapping over its local heads. With
+    ``quantized`` the template grows the ``k_scale``/``v_scale``
+    leaves, matched against ``kv_cache_quant_rules()`` (head axis — now
+    axis 2 of the 3-d scales — sharded over ``model`` like the pool's)."""
     from apex_tpu.partition import kv_cache_rules, match_partition_rules
 
     if rules is None:
-        rules = kv_cache_rules()
+        if quantized:
+            from apex_tpu.partition import kv_cache_quant_rules
+
+            rules = kv_cache_quant_rules()
+        else:
+            rules = kv_cache_rules()
     template = PagedKVCache(
         k=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
         v=jax.ShapeDtypeStruct((1,) * 5, "bfloat16"),
         lengths=jax.ShapeDtypeStruct((1,), "int32"),
         block_tables=jax.ShapeDtypeStruct((1, 1), "int32"))
+    if quantized:
+        template = template._replace(
+            k_scale=jax.ShapeDtypeStruct((1, 1, 1), "float32"),
+            v_scale=jax.ShapeDtypeStruct((1, 1, 1), "float32"))
     return match_partition_rules(rules, template)

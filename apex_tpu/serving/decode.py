@@ -43,7 +43,8 @@ from jax import lax
 
 from apex_tpu.models.gpt import (
     GPTConfig, GPTModel, _block_decode, _block_decode_paged,
-    _block_prefill, _block_verify, _block_verify_paged, _ln,
+    _block_decode_paged_q8, _block_prefill, _block_verify,
+    _block_verify_paged, _block_verify_paged_q8, _ln,
     _rope_or_none, _tied_lm_logits,
 )
 from apex_tpu.serving.cache import (
@@ -201,21 +202,37 @@ def _paged_prefill_core(params, cfg: GPTConfig, cache: PagedKVCache, ids,
     logits = logits_fn(params, h_last)
     mz = mask.astype(k.dtype)[None, None, None, :, None]
 
-    def tiles(t, pool):
+    def tiles(t):
         # (L, 1, nh, s, hd) -> page tiles (L, n_bucket_pages, nh,
         # page_size, hd), zero-padded tail included (scratch eats it)
         lyr, _, nh, _, hd = t.shape
-        t = (t * mz).astype(pool.dtype)[:, 0]
+        t = (t * mz)[:, 0]
         t = t.reshape(lyr, nh, n_bucket_pages, page_size, hd)
         return t.transpose(0, 2, 1, 3, 4)
 
+    lengths = lax.dynamic_update_slice(cache.lengths, length[None],
+                                       (slot,))
+    block_tables = lax.dynamic_update_slice(
+        cache.block_tables, table_row[None, :], (slot, 0))
+    if cache.k_scale is not None:
+        # int8 pool: quantize each freshly-written page per head (amax
+        # over the page, zeroed pad rows quantize to exact 0) and
+        # scatter tiles + scales together — 6 alias pairs
+        from apex_tpu.quant.kernels import kv_quantize
+
+        kq, ks = kv_quantize(tiles(k))
+        vq, vs = kv_quantize(tiles(v))
+        new = PagedKVCache(
+            k=cache.k.at[:, write_pages].set(kq),
+            v=cache.v.at[:, write_pages].set(vq),
+            lengths=lengths, block_tables=block_tables,
+            k_scale=cache.k_scale.at[:, write_pages].set(ks),
+            v_scale=cache.v_scale.at[:, write_pages].set(vs))
+        return new, logits
     new = PagedKVCache(
-        k=cache.k.at[:, write_pages].set(tiles(k, cache.k)),
-        v=cache.v.at[:, write_pages].set(tiles(v, cache.v)),
-        lengths=lax.dynamic_update_slice(cache.lengths, length[None],
-                                         (slot,)),
-        block_tables=lax.dynamic_update_slice(
-            cache.block_tables, table_row[None, :], (slot, 0)))
+        k=cache.k.at[:, write_pages].set(tiles(k).astype(cache.k.dtype)),
+        v=cache.v.at[:, write_pages].set(tiles(v).astype(cache.v.dtype)),
+        lengths=lengths, block_tables=block_tables)
     return new, logits
 
 
@@ -235,6 +252,22 @@ def _paged_decode_core(params, cfg: GPTConfig, cache: PagedKVCache,
     bt = cache.block_tables
     x = embed_fn(params, tokens[:, None], pos=pos)
     freqs = _rope_or_none(cfg, bt.shape[1] * cache.k.shape[3])
+
+    if cache.k_scale is not None:
+        def body(x, layer_slice):
+            lp, kp, vp, ks, vs = layer_slice
+            x, kp, vp, ks, vs = _block_decode_paged_q8(
+                lp, x, kp, vp, ks, vs, bt, pos, cfg, freqs, *dense_fns)
+            return x, (kp, vp, ks, vs)
+
+        x, (k, v, ks, vs) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v,
+                      cache.k_scale, cache.v_scale))
+        hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+        logits = logits_fn(params, hidden[:, 0])
+        bt = _self_rewrite(bt)
+        return PagedKVCache(k, v, jnp.where(active, pos + 1, pos), bt,
+                            ks, vs), logits
 
     def body(x, layer_slice):
         lp, kp, vp = layer_slice
@@ -261,6 +294,21 @@ def _paged_verify_core(params, cfg: GPTConfig, cache: PagedKVCache,
     bt = cache.block_tables
     x = embed_fn(params, tokens, pos=pos)
     freqs = _rope_or_none(cfg, bt.shape[1] * cache.k.shape[3])
+
+    if cache.k_scale is not None:
+        def body(x, layer_slice):
+            lp, kp, vp, ks, vs = layer_slice
+            x, kp, vp, ks, vs = _block_verify_paged_q8(
+                lp, x, kp, vp, ks, vs, bt, pos, cfg, freqs, *dense_fns)
+            return x, (kp, vp, ks, vs)
+
+        x, (k, v, ks, vs) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v,
+                      cache.k_scale, cache.v_scale))
+        hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+        logits = logits_fn(params, hidden)
+        return PagedKVCache(k, v, _self_rewrite(pos), _self_rewrite(bt),
+                            ks, vs), logits
 
     def body(x, layer_slice):
         lp, kp, vp = layer_slice
@@ -309,86 +357,148 @@ def _logits_unsharded(params, hidden):
         jnp.float32)
 
 
-def make_prefill_fn(cfg: GPTConfig, compute_dtype=None):
+def _dense_w8(p, x):
+    """Weight-only int8 linear: the dequant-fused Pallas matmul against
+    the layer's int8 kernel + per-output-channel fp32 scale."""
+    from apex_tpu.quant.kernels import w8_matmul
+
+    return w8_matmul(x, p["kernel"], p["scale"], p["bias"],
+                     out_dtype=x.dtype)
+
+
+def _embed_w8(cfg: GPTConfig, compute_dtype):
+    """Embedding lookup from the int8 word table: take rows, dequant
+    each against its per-row (per-vocab-entry) scale — the gather is
+    O(b·s·h), so the dequant stays plain jnp."""
+
+    def embed(params, ids, pos=None):
+        word = params["embedding"]["word"]
+        x = jnp.take(word["embedding"], ids, axis=0).astype(jnp.float32) \
+            * jnp.take(word["scale"], ids, axis=0)[..., None]
+        x = x.astype(jnp.float32 if compute_dtype is None
+                     else compute_dtype)
+        if not cfg.use_rope:
+            ptab = params["embedding"]["position"]["embedding"]
+            if pos is None:
+                x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
+            else:
+                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
+        return x
+
+    return embed
+
+
+def _logits_w8(params, hidden):
+    """Tied logits head against the output-channel-major int8 word
+    table — ``w8_matmul_nk`` contracts without transposing it."""
+    from apex_tpu.quant.kernels import w8_matmul_nk
+
+    word = params["embedding"]["word"]
+    return w8_matmul_nk(hidden, word["embedding"], word["scale"])
+
+
+def _unsharded_fns(cfg: GPTConfig, compute_dtype, quantized):
+    if quantized:
+        return (_embed_w8(cfg, compute_dtype), (_dense_w8,) * 4,
+                _logits_w8)
+    return (_embed_unsharded(cfg, compute_dtype), (_dense,) * 4,
+            _logits_unsharded)
+
+
+def make_prefill_fn(cfg: GPTConfig, compute_dtype=None, quantized=False):
     """jit(prefill) with the cache DONATED. One compiled executable per
     (bucket length, cache shape) — call through a bucketing layer (the
-    scheduler does) so recompiles are per bucket, never per request."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+    scheduler does) so recompiles are per bucket, never per request.
+    ``quantized`` expects the weight-only int8 tree of
+    ``apex_tpu.quant.quantize_params`` (every builder here does)."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def prefill(params, cache, ids, mask, slot):
         return _prefill_core(params, cfg, cache, ids, mask, slot,
-                             embed_fn=embed, dense_fns=(_dense,) * 4,
-                             logits_fn=_logits_unsharded)
+                             embed_fn=embed, dense_fns=dense_fns,
+                             logits_fn=logits_fn)
 
     return jax.jit(prefill, donate_argnums=1)
 
 
-def make_decode_fn(cfg: GPTConfig, compute_dtype=None):
+def make_decode_fn(cfg: GPTConfig, compute_dtype=None, quantized=False):
     """jit(decode) with the cache DONATED; compiles once per cache
     shape (batch of slots advances together)."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def decode(params, cache, tokens, active):
         return _decode_core(params, cfg, cache, tokens, active,
-                            embed_fn=embed, dense_fns=(_dense,) * 4,
-                            logits_fn=_logits_unsharded)
+                            embed_fn=embed, dense_fns=dense_fns,
+                            logits_fn=logits_fn)
 
     return jax.jit(decode, donate_argnums=1)
 
 
-def make_paged_prefill_fn(cfg: GPTConfig, compute_dtype=None):
+def make_paged_prefill_fn(cfg: GPTConfig, compute_dtype=None,
+                          quantized=False):
     """jit(paged prefill), cache DONATED (4 alias pairs: pool k/v,
-    lengths, block tables). Compiles per bucket, like the dense path."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+    lengths, block tables; 6 with an int8 cache's scales). Compiles per
+    bucket, like the dense path."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def prefill(params, cache, ids, mask, slot, write_pages, table_row):
         return _paged_prefill_core(params, cfg, cache, ids, mask, slot,
                                    write_pages, table_row,
                                    embed_fn=embed,
-                                   dense_fns=(_dense,) * 4,
-                                   logits_fn=_logits_unsharded)
+                                   dense_fns=dense_fns,
+                                   logits_fn=logits_fn)
 
     return jax.jit(prefill, donate_argnums=1)
 
 
-def make_paged_decode_fn(cfg: GPTConfig, compute_dtype=None):
+def make_paged_decode_fn(cfg: GPTConfig, compute_dtype=None,
+                         quantized=False):
     """jit(paged decode), cache DONATED; one executable per pool
     shape."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def decode(params, cache, tokens, active):
         return _paged_decode_core(params, cfg, cache, tokens, active,
                                   embed_fn=embed,
-                                  dense_fns=(_dense,) * 4,
-                                  logits_fn=_logits_unsharded)
+                                  dense_fns=dense_fns,
+                                  logits_fn=logits_fn)
 
     return jax.jit(decode, donate_argnums=1)
 
 
-def make_verify_fn(cfg: GPTConfig, compute_dtype=None):
+def make_verify_fn(cfg: GPTConfig, compute_dtype=None, quantized=False):
     """jit(speculative verify) with the cache DONATED; one executable
     per (cache shape, k1) — the scheduler runs a single k1 = spec_k + 1
     bucket (shorter drafts pad with token 0; the host bounds acceptance
     by the true draft length), so this compiles once."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def verify(params, cache, tokens):
         return _verify_core(params, cfg, cache, tokens,
-                            embed_fn=embed, dense_fns=(_dense,) * 4,
-                            logits_fn=_logits_unsharded)
+                            embed_fn=embed, dense_fns=dense_fns,
+                            logits_fn=logits_fn)
 
     return jax.jit(verify, donate_argnums=1)
 
 
-def make_paged_verify_fn(cfg: GPTConfig, compute_dtype=None):
-    """jit(paged speculative verify), cache DONATED (4 alias pairs)."""
-    embed = _embed_unsharded(cfg, compute_dtype)
+def make_paged_verify_fn(cfg: GPTConfig, compute_dtype=None,
+                         quantized=False):
+    """jit(paged speculative verify), cache DONATED (4 alias pairs; 6
+    with an int8 cache's scales)."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
 
     def verify(params, cache, tokens):
         return _paged_verify_core(params, cfg, cache, tokens,
                                   embed_fn=embed,
-                                  dense_fns=(_dense,) * 4,
-                                  logits_fn=_logits_unsharded)
+                                  dense_fns=dense_fns,
+                                  logits_fn=logits_fn)
 
     return jax.jit(verify, donate_argnums=1)
 
@@ -398,7 +508,9 @@ def make_copy_page_fn():
     the device half of copy-on-write: the host picks ``src``/``dst``
     (``PagePool.needs_copy``), this clones the rows so the shared
     original is never mutated. Scalar page ids keep it one executable
-    regardless of which pages diverge."""
+    regardless of which pages diverge. An int8 cache clones the page's
+    scale rows together with its tiles — the COW copy of a quantized
+    page is bit-identical (same int8 rows, same scales)."""
 
     def copy(cache, src, dst):
         def clone(pool):
@@ -406,7 +518,11 @@ def make_copy_page_fn():
             return lax.dynamic_update_slice_in_dim(pool, page, dst,
                                                    axis=1)
 
-        return cache._replace(k=clone(cache.k), v=clone(cache.v))
+        new = cache._replace(k=clone(cache.k), v=clone(cache.v))
+        if cache.k_scale is not None:
+            new = new._replace(k_scale=clone(cache.k_scale),
+                               v_scale=clone(cache.v_scale))
+        return new
 
     return jax.jit(copy, donate_argnums=0)
 
@@ -444,16 +560,87 @@ def _tp_fns(model: GPTModel):
     return embed, dense_fns, logits
 
 
-def make_tp_prefill_fn(model: GPTModel, mesh=None):
+def _tp_quant_fns(model: GPTModel):
+    """Quantized twins of :func:`_tp_fns`: the same Megatron collective
+    structure (Column: copy-in, no gather; Row: local matmul, reduce,
+    then the replicated bias; vocab-parallel embed/logits) with the
+    local matmuls swapped for the dequant-fused int8 kernels. The
+    quantized tree shards exactly like bf16 (kernel paths unchanged,
+    scales split with their output channel —
+    ``apex_tpu.quant.quant_partition_specs``), so each rank's
+    ``w8_matmul`` sees a coherent (local kernel, local scale) pair."""
+    from jax import lax
+
+    from apex_tpu.quant.kernels import w8_matmul, w8_matmul_nk
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    cfg = model.cfg
+
+    def embed(params, ids, pos=None):
+        # VocabParallelEmbedding.apply over the int8 row shard: local
+        # rows dequant per vocab entry, out-of-range rows zero, psum
+        word = params["embedding"]["word"]
+        table = word["embedding"]          # (V/p, h) int8 local shard
+        per_rank = table.shape[0]
+        start = lax.axis_index(ps.TENSOR_AXIS) * per_rank
+        local = ids - start
+        in_range = (local >= 0) & (local < per_rank)
+        safe = jnp.where(in_range, local, 0)
+        out = jnp.take(table, safe, axis=0).astype(jnp.float32) \
+            * jnp.take(word["scale"], safe, axis=0)[..., None]
+        out = jnp.where(in_range[..., None], out, 0.0)
+        x = mappings.reduce_from_tensor_model_parallel_region(out)
+        if not cfg.use_rope:
+            ptab = params["embedding"]["position"]["embedding"]
+            if pos is None:
+                x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
+            else:
+                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
+        return x
+
+    def column(p, x):
+        x = mappings.copy_to_tensor_model_parallel_region(x)
+        return w8_matmul(x, p["kernel"], p["scale"], p["bias"],
+                         out_dtype=x.dtype)
+
+    def row(p, x):
+        # bias AFTER the reduction, replicated — RowParallelLinear's
+        # contract (adding it per-rank would add it p times)
+        y = w8_matmul(x, p["kernel"], p["scale"], out_dtype=x.dtype)
+        y = mappings.reduce_from_tensor_model_parallel_region(y)
+        return y + p["bias"].astype(y.dtype)
+
+    def logits(params, hidden):
+        word = params["embedding"]["word"]
+        hidden = mappings.copy_to_tensor_model_parallel_region(hidden)
+        local = w8_matmul_nk(hidden, word["embedding"], word["scale"])
+        return mappings.gather_from_tensor_model_parallel_region(local)
+
+    return embed, (column, row, column, row), logits
+
+
+def _tp_build(model: GPTModel, quantized: bool):
+    """(embed/dense/logits fns, param specs) for the TP builders."""
+    if quantized:
+        from apex_tpu.quant.params import quant_partition_specs
+
+        return _tp_quant_fns(model), quant_partition_specs(model.cfg)
+    return _tp_fns(model), model.partition_specs()
+
+
+def make_tp_prefill_fn(model: GPTModel, mesh=None, quantized=False):
     """TP prefill: ``jit(shard_map(...))`` over the global mesh, cache
-    donated. Params use ``model.partition_specs()``; the cache uses
+    donated. Params use ``model.partition_specs()`` (or the quantized
+    tree's ``quant_partition_specs``); the cache uses
     ``cache_partition_specs()`` (heads over ``model``)."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
     cspecs = cache_partition_specs()
 
     def prefill(params, cache, ids, mask, slot):
@@ -463,18 +650,18 @@ def make_tp_prefill_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         prefill, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P(), P(), P()),
+        in_specs=(pspecs, cspecs, P(), P(), P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 
 
-def make_tp_decode_fn(model: GPTModel, mesh=None):
+def make_tp_decode_fn(model: GPTModel, mesh=None, quantized=False):
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
     cspecs = cache_partition_specs()
 
     def decode(params, cache, tokens, active):
@@ -484,12 +671,12 @@ def make_tp_decode_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         decode, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P(), P()),
+        in_specs=(pspecs, cspecs, P(), P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 
 
-def make_tp_verify_fn(model: GPTModel, mesh=None):
+def make_tp_verify_fn(model: GPTModel, mesh=None, quantized=False):
     """TP speculative verify: the (b, k1, V) logits leave through the
     same vocab-sharded head + rank-order gather as decode's."""
     from jax.sharding import PartitionSpec as P
@@ -497,7 +684,7 @@ def make_tp_verify_fn(model: GPTModel, mesh=None):
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
     cspecs = cache_partition_specs()
 
     def verify(params, cache, tokens):
@@ -507,22 +694,25 @@ def make_tp_verify_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         verify, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P()),
+        in_specs=(pspecs, cspecs, P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 
 
-def make_tp_paged_prefill_fn(model: GPTModel, mesh=None):
+def make_tp_paged_prefill_fn(model: GPTModel, mesh=None, quantized=False,
+                             kv_quantized=False):
     """TP paged prefill: the pool's head axis shards over ``model``;
     block tables / page ids are replicated host decisions, so every
-    rank scatters its local heads' tiles to the same physical pages."""
+    rank scatters its local heads' tiles to the same physical pages.
+    ``kv_quantized`` switches the cache specs to the int8 pool's (the
+    scales shard their head axis over ``model`` too)."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
-    cspecs = paged_cache_partition_specs()
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = paged_cache_partition_specs(quantized=kv_quantized)
 
     def prefill(params, cache, ids, mask, slot, write_pages, table_row):
         return _paged_prefill_core(params, cfg, cache, ids, mask, slot,
@@ -532,20 +722,21 @@ def make_tp_paged_prefill_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         prefill, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P(), P(), P(), P(),
+        in_specs=(pspecs, cspecs, P(), P(), P(), P(),
                   P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 
 
-def make_tp_paged_decode_fn(model: GPTModel, mesh=None):
+def make_tp_paged_decode_fn(model: GPTModel, mesh=None, quantized=False,
+                            kv_quantized=False):
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
-    cspecs = paged_cache_partition_specs()
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = paged_cache_partition_specs(quantized=kv_quantized)
 
     def decode(params, cache, tokens, active):
         return _paged_decode_core(params, cfg, cache, tokens, active,
@@ -554,19 +745,20 @@ def make_tp_paged_decode_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         decode, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P(), P()),
+        in_specs=(pspecs, cspecs, P(), P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 
 
-def make_tp_paged_verify_fn(model: GPTModel, mesh=None):
+def make_tp_paged_verify_fn(model: GPTModel, mesh=None, quantized=False,
+                            kv_quantized=False):
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.transformer import parallel_state as ps
 
     cfg = model.cfg
-    embed, dense_fns, logits_fn = _tp_fns(model)
-    cspecs = paged_cache_partition_specs()
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = paged_cache_partition_specs(quantized=kv_quantized)
 
     def verify(params, cache, tokens):
         return _paged_verify_core(params, cfg, cache, tokens,
@@ -575,6 +767,6 @@ def make_tp_paged_verify_fn(model: GPTModel, mesh=None):
 
     sharded = ps.shard_map(
         verify, mesh=mesh,
-        in_specs=(model.partition_specs(), cspecs, P()),
+        in_specs=(pspecs, cspecs, P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)

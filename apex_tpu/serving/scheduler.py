@@ -101,6 +101,7 @@ from apex_tpu.serving.health import (
     AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
     PoolExhausted, RequestOutcome, RetryBudgetExhausted, ServingStats,
 )
+from apex_tpu.quant.params import is_quantized_tree
 from apex_tpu.serving.paging import PagePool, prefix_page_keys
 from apex_tpu.serving.sampling import (
     finite_rows, sample_token_grid, sample_tokens,
@@ -165,10 +166,17 @@ class DecodeEngine:
         self.spec_k = spec_k
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
+        if jnp.dtype(cache_dtype) == jnp.int8:
+            raise ValueError(
+                "the dense cache has no int8 mode (per-page scales need "
+                "pages); use PagedDecodeEngine for kv_dtype=int8")
+        # weight-only int8 trees are auto-detected: the builders swap in
+        # the dequant-fused kernels, everything else is unchanged
+        quantized = is_quantized_tree(params)
         self.cache = init_cache(cfg, num_slots, max_len, cache_dtype)
-        self._prefill = make_prefill_fn(cfg, compute_dtype)
-        self._decode = make_decode_fn(cfg, compute_dtype)
-        self._verify = make_verify_fn(cfg, compute_dtype)
+        self._prefill = make_prefill_fn(cfg, compute_dtype, quantized)
+        self._decode = make_decode_fn(cfg, compute_dtype, quantized)
+        self._verify = make_verify_fn(cfg, compute_dtype, quantized)
         self._init_samplers()
 
     def _init_samplers(self) -> None:
@@ -354,14 +362,21 @@ class PagedDecodeEngine(DecodeEngine):
         self.spec_k = spec_k
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
+        # both quantization levers are independent: weight-only int8 is
+        # detected from the tree (dequant-fused dense/logits kernels),
+        # kv_dtype=int8 from the cache (the cores branch on the scale
+        # leaves the int8 pool carries) — the host side (PagePool, COW,
+        # block tables) is dtype-agnostic throughout
+        quantized = is_quantized_tree(params)
         self.cache = init_paged_cache(cfg, num_slots, max_len, num_pages,
                                       page_size, cache_dtype)
         self.pool = PagePool(num_pages, page_size, free_order,
                              injector=self.injector)
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
-        self._prefill = make_paged_prefill_fn(cfg, compute_dtype)
-        self._decode = make_paged_decode_fn(cfg, compute_dtype)
-        self._verify = make_paged_verify_fn(cfg, compute_dtype)
+        self._prefill = make_paged_prefill_fn(cfg, compute_dtype,
+                                              quantized)
+        self._decode = make_paged_decode_fn(cfg, compute_dtype, quantized)
+        self._verify = make_paged_verify_fn(cfg, compute_dtype, quantized)
         self._copy = make_copy_page_fn()
         self._init_samplers()
 

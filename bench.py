@@ -570,17 +570,21 @@ def _decode_bench_setup(on_tpu, cache_dtype, slots=None):
     return body, make_init, fetch, slots, s_max, cfg
 
 
-def _decode_cost_numbers(cfg, slots, depth, param_dtype, cache_dtype):
-    """(model_bytes_per_token, kv_bytes_per_step) from the APX6xx
-    abstract cost interpreter, over the same decode program at the
-    parked cache depth. Pure trace — no compile, no device work — so it
-    prices the roofline the measured tokens/sec should be compared
-    against. ``kv_bytes_per_step`` isolates the cache slice of that
-    traffic: the full K/V read (both cache invars, charged once per
-    step by the interpreter) plus the in-place row writes
+def _decode_cost_numbers(cfg, slots, depth, param_dtype, cache_dtype,
+                         quantized=False):
+    """(model_bytes_per_token, kv_bytes_per_step, weight_bytes_per_token)
+    from the APX6xx abstract cost interpreter, over the same decode
+    program at the parked cache depth. Pure trace — no compile, no
+    device work — so it prices the roofline the measured tokens/sec
+    should be compared against. ``kv_bytes_per_step`` isolates the cache
+    slice of that traffic: the full K/V read (both cache invars, charged
+    once per step by the interpreter) plus the in-place row writes
     (``delta_write_bytes``) — exactly the term the paged layout makes
     length-proportional (see the ``decode_paged_vs_dense`` A/B pair and
-    BASELINE r10)."""
+    BASELINE r10). ``weight_bytes_per_token`` isolates the parameter
+    slice of the interpreter's invar read charge, amortized over the
+    batch — the term weight-only int8 halves (``quantized=True`` prices
+    the int8 tree: same program, int8 kernel invars + fp32 scales)."""
     import math
 
     from apex_tpu.lint.traced import cost
@@ -590,16 +594,23 @@ def _decode_cost_numbers(cfg, slots, depth, param_dtype, cache_dtype):
 
     params = jax.eval_shape(
         lambda k: init_gpt(k, cfg, param_dtype), jax.random.PRNGKey(0))
+    if quantized:
+        from apex_tpu.quant.params import quantize_params
+
+        params = quantize_params(params)
     cache = jax.eval_shape(
         functools.partial(init_cache, cfg, slots, depth, cache_dtype))
-    closed = jax.make_jaxpr(make_decode_fn(cfg))(
+    closed = jax.make_jaxpr(make_decode_fn(cfg, quantized=quantized))(
         params, cache, jax.ShapeDtypeStruct((slots,), jnp.int32),
         jax.ShapeDtypeStruct((slots,), jnp.bool_))
     rep = cost.compute(closed, __file__, "gpt_decode")
     kv_read = sum(math.prod(t.shape) * t.dtype.itemsize
                   for t in (cache.k, cache.v))
+    weight_read = sum(math.prod(t.shape) * t.dtype.itemsize
+                      for t in jax.tree_util.tree_leaves(params))
     return (int(rep.hbm_total_bytes // slots),
-            int(kv_read + rep.delta_write_bytes))
+            int(kv_read + rep.delta_write_bytes),
+            int(weight_read // slots))
 
 
 def _serving_stats_probe():
@@ -711,10 +722,16 @@ def bench_gpt_decode(on_tpu):
                   "cache_dtype": "bfloat16",
                   "per_token_latency_ms": round(dt * 1e3, 3)})
     try:
-        extra["model_bytes_per_token"], extra["kv_bytes_per_step"] = \
-            _decode_cost_numbers(
-                cfg, slots, s_max // 2,
-                jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16)
+        (extra["model_bytes_per_token"], extra["kv_bytes_per_step"],
+         extra["weight_bytes_per_token"]) = _decode_cost_numbers(
+            cfg, slots, s_max // 2,
+            jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16)
+        # the int8 tree over the same program: the weight-read halving
+        # the quantized tier banks on, priced next to the measured rate
+        extra["weight_bytes_per_token_w8"] = _decode_cost_numbers(
+            cfg, slots, s_max // 2,
+            jnp.bfloat16 if on_tpu else jnp.float32, jnp.bfloat16,
+            quantized=True)[2]
     except Exception as e:  # static cross-check must never sink the bench
         extra["model_bytes_per_token_error"] = repr(e)
     try:
@@ -851,6 +868,166 @@ def _decode_cache_ab_pair(on_tpu):
         return _ab_side(body, make_init(), fetch, M=10 if on_tpu else 2)
 
     return side(jnp.bfloat16), side(jnp.float32)
+
+
+def _w8_decode_ab_pair(on_tpu):
+    """(side_a, side_b): weight-only int8 decode (dequant-fused Pallas
+    matmuls, fp32 scales) vs the bf16 dense step — same model, cache
+    depth and token feedback, so the ratio prices the parameter-read
+    halving on the measured step rather than the static table. The
+    cache stays bf16 on BOTH sides: this pair isolates the weight
+    axis; ``decode_kv8_vs_bf16`` isolates the cache axis."""
+    import dataclasses
+
+    from apex_tpu.models.gpt import GPTConfig, gpt_tiny, init_gpt
+    from apex_tpu.quant.params import quantize_params
+    from apex_tpu.serving.cache import init_cache
+    from apex_tpu.serving.decode import _decode_core, _unsharded_fns
+
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        ffn_hidden_size=4096, vocab_size=50304,
+                        max_position_embeddings=1024, use_rope=True,
+                        hidden_dropout=0.0)
+        slots, s_max = 16, 256
+        param_dtype = jnp.bfloat16
+    else:
+        cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                                  hidden_dropout=0.0)
+        slots, s_max = 2, 32
+        param_dtype = jnp.float32
+    params = init_gpt(jax.random.PRNGKey(0), cfg, param_dtype)
+    active = jnp.zeros((slots,), bool)
+    tokens0 = jnp.zeros((slots,), jnp.int32)
+    M = 10 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[1]).astype(jnp.float32)  # noqa: E731
+
+    def side(p, quantized):
+        embed, dense_fns, logits_fn = _unsharded_fns(cfg, None, quantized)
+
+        def body(state, p=p):
+            cache, tokens = state
+            cache, logits = _decode_core(
+                p, cfg, cache, tokens, active, embed_fn=embed,
+                dense_fns=dense_fns, logits_fn=logits_fn)
+            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        cache = init_cache(cfg, slots, s_max, jnp.bfloat16)._replace(
+            lengths=jnp.full((slots,), s_max // 2, jnp.int32))
+        return _ab_side(body, (cache, tokens0), fetch, M)
+
+    return side(quantize_params(params), True), side(params, False)
+
+
+def _kv8_decode_ab_pair(on_tpu):
+    """(side_a, side_b): int8 page pool (per-page-per-head fp32 scales,
+    whole-page RMW requant on write) vs the bf16 pool on the paged
+    ragged decode — bf16 weights on BOTH sides, so the ratio prices the
+    cache-read halving net of the requant read-modify-write the int8
+    write path adds. Same ragged ladder as ``decode_paged_vs_dense``."""
+    import dataclasses
+
+    from apex_tpu.models.gpt import GPTConfig, gpt_tiny, init_gpt
+    from apex_tpu.serving.cache import (
+        NULL_PAGE, RESERVED_PAGES, init_paged_cache, max_pages_per_slot,
+    )
+    from apex_tpu.serving.decode import (
+        _dense, _embed_unsharded, _logits_unsharded, _paged_decode_core,
+    )
+
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        ffn_hidden_size=4096, vocab_size=50304,
+                        max_position_embeddings=1024, use_rope=True,
+                        hidden_dropout=0.0)
+        slots, s_max, page = 32, 512, 64
+        param_dtype = jnp.bfloat16
+    else:
+        cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                                  hidden_dropout=0.0)
+        slots, s_max, page = 4, 64, 16
+        param_dtype = jnp.float32
+    lo = s_max // 16
+    lengths = [lo + round(i * (s_max - lo) / (slots - 1))
+               for i in range(slots)]
+    params = init_gpt(jax.random.PRNGKey(0), cfg, param_dtype)
+    embed = _embed_unsharded(cfg, None)
+    lengths_arr = jnp.asarray(lengths, jnp.int32)
+    active = jnp.zeros((slots,), bool)
+    tokens0 = jnp.zeros((slots,), jnp.int32)
+    M = 10 if on_tpu else 2
+    fetch = lambda s: jnp.sum(s[1]).astype(jnp.float32)  # noqa: E731
+
+    def paged_init(dtype):
+        max_pages = max_pages_per_slot(s_max, page)
+        runs = [min(-(-(l + 1) // page), max_pages) for l in lengths]
+        cache = init_paged_cache(cfg, slots, s_max,
+                                 RESERVED_PAGES + sum(runs), page, dtype)
+        rows, nxt = [], RESERVED_PAGES
+        for n in runs:
+            rows.append(list(range(nxt, nxt + n))
+                        + [NULL_PAGE] * (max_pages - n))
+            nxt += n
+        return cache._replace(
+            lengths=lengths_arr,
+            block_tables=jnp.asarray(rows, jnp.int32))
+
+    def side(dtype):
+        def body(state):
+            cache, tokens = state
+            cache, logits = _paged_decode_core(
+                params, cfg, cache, tokens, active, embed_fn=embed,
+                dense_fns=(_dense,) * 4, logits_fn=_logits_unsharded)
+            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        return _ab_side(body, (paged_init(dtype), tokens0), fetch, M)
+
+    return side(jnp.int8), side(jnp.bfloat16)
+
+
+def _w8kv8_spec_ab_pair(on_tpu):
+    """(side_a, side_b): the spec_k=4 draft→verify→accept scheduler
+    drain with the FULL quantized tier (int8 weights + int8 page pool)
+    vs the same drain at bf16, scored as seconds per committed token —
+    does the byte saving survive the end-to-end tick loop (host
+    drafting + dequant-fused verify + accept walk), or does the requant
+    RMW eat it at this scale."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.quant.params import quantize_params
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  PagedDecodeEngine, Request)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    slots = 4
+    max_new = 48 if on_tpu else 24
+
+    def side(quantized):
+        eng = PagedDecodeEngine(
+            quantize_params(params) if quantized else params, cfg,
+            num_slots=slots, max_len=128, num_pages=128, page_size=8,
+            buckets=(16,), spec_k=4,
+            cache_dtype=jnp.int8 if quantized else jnp.bfloat16)
+
+        def run():
+            sched = ContinuousBatchingScheduler(eng, eos_id=-1)
+            for i in range(slots):
+                sched.submit(Request(prompt=(5 + i, 7 + i) * 6,
+                                     max_new_tokens=max_new))
+            return sum(len(s) for s in sched.run())
+
+        run()  # compile prefill/verify + warm the host draft path
+
+        def sample():
+            t0 = time.perf_counter()
+            n = run()
+            return (time.perf_counter() - t0) / n
+
+        return sample
+
+    return side(True), side(False)
 
 
 # -- flash-attention microbench: kernel vs unfused at long seq --------------
@@ -1225,6 +1402,15 @@ AB_PAIRS = {
     "decode_spec_vs_plain": (
         "spec_k4", "plain",
         _spec_vs_plain_decode_ab_pair),
+    "decode_w8_vs_bf16": (
+        "w8_weights", "bf16_weights",
+        _w8_decode_ab_pair),
+    "decode_kv8_vs_bf16": (
+        "kv8_pool", "bf16_pool",
+        _kv8_decode_ab_pair),
+    "decode_w8kv8_spec": (
+        "w8kv8_spec_k4", "bf16_spec_k4",
+        _w8kv8_spec_ab_pair),
 }
 
 

@@ -17,7 +17,10 @@
 #   ./run_tests.sh gate     # L1 loss-curve gate: amp levels AND the
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
-#                           #     fp32 golden curve — run on every PR
+#                           #     fp32 golden curve, and the quantized
+#                           #     serving tiers (w8 / kv8 / w8+kv8) must
+#                           #     track the trained fp32 eval-NLL curve
+#                           #     — run on every PR
 #   ./run_tests.sh lint     # apxlint, all four tiers: AST contract
 #                           #     checks (kernel aliasing, collectives,
 #                           #     AMP lists, hygiene), the VMEM budget
@@ -43,7 +46,8 @@ case "$tier" in
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
   chaos) exec python -m pytest tests -q -m chaos "$@" ;;
-  gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py -q "$@" ;;
+  gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py \
+             tests/L1/test_quant_eval_parity.py -q "$@" ;;
   lint)  # combined AST + VMEM + trace + cost + sharding tiers, under a
          # wall-time budget: a slow lint gate stops being run, so
          # exceeding the budget is itself a failure (trim the entry

@@ -1,0 +1,36 @@
+# apxlint: fixture
+# Known-bad: the int8 quantization contract broken four ways — a bf16
+# scale scratch tile, a store into scale_out that rounds through
+# astype(bfloat16), a dequant-fused dot with no fp32
+# preferred_element_type, and a truncating astype(int8) with no
+# rounding call in scope. Each must raise APX106.
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _w8_body(x_ref, wq_ref, scale_ref, out_ref, new_scale_out,
+             scale_scratch):
+    w = wq_ref[...].astype(jnp.float32) * scale_ref[...]
+    out_ref[...] = jnp.dot(x_ref[...], w)  # no preferred_element_type
+    new_scale_out[...] = scale_ref[...].astype(jnp.bfloat16)
+
+
+def dequant_matmul(x, wq, scale):
+    spec = pl.BlockSpec((128, 128), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _w8_body,
+        grid=(4,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((128,), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((128,), jnp.bfloat16)],
+    )(x, wq, scale)
+
+
+def quantize_truncating(t):
+    scale = jnp.abs(t).max() / 127.0
+    return (t / scale).astype(jnp.int8), scale  # truncates toward zero

@@ -1,0 +1,35 @@
+# apxlint: fixture
+# Known-clean twin of apx106_bad.py: fp32 scale scratch and store, an
+# fp32 preferred_element_type on the dequant dot, and an astype(int8)
+# preceded by jnp.round in the same function. Must raise nothing.
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _w8_body(x_ref, wq_ref, scale_ref, out_ref, new_scale_out,
+             scale_scratch):
+    w = wq_ref[...].astype(jnp.float32) * scale_ref[...]
+    out_ref[...] = jnp.dot(x_ref[...], w,
+                           preferred_element_type=jnp.float32)
+    new_scale_out[...] = scale_ref[...]
+
+
+def dequant_matmul(x, wq, scale):
+    spec = pl.BlockSpec((128, 128), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _w8_body,
+        grid=(4,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec),
+        out_shape=(jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                   jax.ShapeDtypeStruct((128,), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((128,), jnp.float32)],
+    )(x, wq, scale)
+
+
+def quantize_rtn(t):
+    scale = jnp.abs(t).max() / 127.0
+    return jnp.clip(jnp.round(t / scale), -127, 127).astype(jnp.int8), scale

@@ -22,7 +22,7 @@ def _codes(*names, **kw):
 
 def test_codes_registry_complete():
     assert set(CODES) == {
-        "APX100", "APX101", "APX102", "APX103", "APX105",
+        "APX100", "APX101", "APX102", "APX103", "APX105", "APX106",
         "APX201", "APX202",
         "APX301", "APX302", "APX303", "APX304",
         "APX401", "APX402",
@@ -44,6 +44,14 @@ def test_apx103_stats_precision():
     # bf16 m scratch, bf16 lse output, downcast store into l_ref
     assert codes.count("APX103") == 3, codes
     assert _codes("apx103_clean.py") == []
+
+
+def test_apx106_quant_contracts():
+    codes = _codes("apx106_bad.py")
+    # bf16 scale scratch, downcast store into scale_out, dot without
+    # preferred_element_type, truncating astype(int8)
+    assert codes.count("APX106") == 4, codes
+    assert _codes("apx106_clean.py") == []
 
 
 def test_apx201_collective_divergence():

@@ -41,12 +41,12 @@ def model():
     return cfg, init_gpt(jax.random.PRNGKey(0), cfg)
 
 
-def _engine(model, injector=None, num_slots=2, num_pages=20):
+def _engine(model, injector=None, num_slots=2, num_pages=20, **kw):
     cfg, params = model
     return PagedDecodeEngine(params, cfg, num_slots=num_slots,
                              max_len=MAX_LEN, num_pages=num_pages,
                              page_size=4, buckets=(16, 32),
-                             injector=injector)
+                             injector=injector, **kw)
 
 
 def _drive(engine, reqs, **kw):
@@ -400,6 +400,39 @@ def test_multi_fault_chaos_is_typed_prefixed_and_replayable(model, seed):
     def chaos_run():
         eng = _engine(model, FaultInjector(seed=seed, rates=rates),
                       num_pages=12)
+        sched, _ = _drive(eng, reqs, audit=True)
+        return sched
+
+    sched = chaos_run()
+    _check_contract(sched, reqs, golden)
+    replay = chaos_run()
+    assert replay.outcomes == sched.outcomes
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts
+
+@pytest.mark.slow
+def test_multi_fault_chaos_on_int8_pool(model):
+    """One seed of the randomized sweep on the QUANTIZED page pool
+    (kv_dtype=int8): the degradation contract and bit-exact replay
+    must hold with per-page scales riding the COW-clone, preemption
+    and retry paths. Golden is the int8 engine's own fault-free run —
+    the contract is about fault transparency, not quantization
+    accuracy (that lives in test_quant.py / the L1 parity gate)."""
+    import jax.numpy as jnp
+
+    reqs = [Request(prompt=(7, 11, 13), max_new_tokens=5),
+            Request(prompt=(17, 19), max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=(23, 29, 31, 37, 41), max_new_tokens=6),
+            Request(prompt=(7, 11, 13), max_new_tokens=5,
+                    temperature=0.7, seed=9)]
+    _, golden = _drive(_engine(model, cache_dtype=jnp.int8), reqs)
+    rates = {"pool_alloc": 0.1, "cow_clone": 0.2, "prefill_exec": 0.15,
+             "decode_exec": 0.1, "sample": 0.1}
+
+    def chaos_run():
+        eng = _engine(model, FaultInjector(seed=1, rates=rates),
+                      num_pages=12, cache_dtype=jnp.int8)
         sched, _ = _drive(eng, reqs, audit=True)
         return sched
 
