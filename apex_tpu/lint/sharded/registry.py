@@ -188,6 +188,34 @@ def _gpt_quant_reference():
                 kv_cache_quant_rules(), quantized=True)}
 
 
+def _draft_trees():
+    """The speculative drafter's trees: a RoPE-only param tree (no
+    position leaf) and the DENSE lockstep cache (engine max_len 32 plus
+    DraftModel's catch-up chunk of 5) — exactly what draft_gpt_rules
+    must cover with no dead rows."""
+    import functools as ft
+
+    import jax
+
+    from apex_tpu.models.gpt import draft_gpt_tiny, init_gpt
+    from apex_tpu.serving.cache import init_cache
+
+    cfg = draft_gpt_tiny()
+    params = jax.eval_shape(
+        lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+    cache = jax.eval_shape(ft.partial(init_cache, cfg, 2, 37))
+    return {"params": params, "kv_cache": cache}
+
+
+def _draft_reference():
+    from apex_tpu.models.gpt import draft_gpt_tiny, gpt_partition_specs
+    from apex_tpu.partition import kv_cache_rules
+    from apex_tpu.serving.cache import cache_partition_specs
+
+    return {"params": gpt_partition_specs(draft_gpt_tiny()),
+            "kv_cache": cache_partition_specs(kv_cache_rules())}
+
+
 def _bert_trees():
     import jax
 
@@ -211,7 +239,9 @@ def _bert_reference():
 
 
 def repo_entries() -> List[ShardedEntry]:
-    from apex_tpu.partition import bert_rules, gpt_quant_rules, gpt_rules
+    from apex_tpu.partition import (
+        bert_rules, draft_gpt_rules, gpt_quant_rules, gpt_rules,
+    )
 
     return [
         ShardedEntry(
@@ -229,6 +259,18 @@ def repo_entries() -> List[ShardedEntry]:
             rules=gpt_quant_rules, trees=_gpt_quant_trees,
             reference_specs=_gpt_quant_reference,
             kv_cache_tree="paged_kv_cache",
+            qkv_kernel_re=r"layers/qkv/kernel"),
+        # the speculative drafter: same mesh and layout as the target
+        # minus the rows its trees can never match (position table,
+        # block tables); no optimizer families (inference-only). The kv
+        # consistency check pins the lockstep cache's head axis to the
+        # draft qkv column shard — the invariant that lets the drafter
+        # run TP on the target's mesh without a resharding hop.
+        ShardedEntry(
+            "gpt_draft_rules", "apex_tpu.partition.tables",
+            rules=draft_gpt_rules, trees=_draft_trees,
+            reference_specs=_draft_reference,
+            kv_cache_tree="kv_cache",
             qkv_kernel_re=r"layers/qkv/kernel"),
         ShardedEntry(
             "bert_tiny_rules", "apex_tpu.partition.tables",

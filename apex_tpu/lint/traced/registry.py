@@ -762,6 +762,60 @@ def _spec_verify_step_medium_ragged_entry():
     return build
 
 
+def _tree_verify_step_entry(tp=None):
+    """Tree-attention verify: a k1 = 4-node draft grid per slot against
+    the paged pool — the per-query linear mask of the spec verify
+    replaced by the grid's ancestor-matrix columns. Same 4-leaf cache
+    donation as the linear verify (lengths/block tables come back via
+    the self-row rewrite)."""
+    def build():
+        from apex_tpu.serving.decode import (
+            make_paged_tree_verify_fn, make_tp_paged_tree_verify_fn,
+        )
+
+        cfg = _serving_cfg()
+        params, cache = _paged_serving_args(cfg)
+        if tp is None:
+            fn = make_paged_tree_verify_fn(cfg)
+        else:
+            from apex_tpu.models.gpt import GPTModel
+
+            fn = make_tp_paged_tree_verify_fn(GPTModel(cfg, tp_size=tp))
+        return fn, (params, cache, _sds((2, 4), "int32"),
+                    _sds((2, 4), "int32"), _sds((2, 4, 4), "bool"))
+
+    return build
+
+
+def _draft_forward_step_entry():
+    """The r13 draft-forward anchor: ``draft_gpt_medium`` decoding one
+    greedy token per slot through its dense lockstep cache — 32 slots
+    at the target's s_max = 512 plus DraftModel's chunk = 5 catch-up
+    headroom, bf16 params. Its budgets.json row is the ``draft_bytes``
+    numerator of the BASELINE r13 break-even condition; the ceiling is
+    hand-tightened to < 3% of the target's per-step parameter read
+    (the ``gpt_paged_decode_step_medium_ragged`` row)."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import draft_gpt_medium, init_gpt
+        from apex_tpu.serving.cache import init_cache
+        from apex_tpu.serving.decode import make_decode_fn
+
+        cfg = draft_gpt_medium()
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(init_cache, cfg, 32, 512 + 5))
+        fn = make_decode_fn(cfg)
+        return fn, (params, cache, _sds((32,), "int32"),
+                    _sds((32,), "bool"))
+
+    return build
+
+
 def _w8_matmul_entry():
     """The dequant-fused int8 matmul family (column/row apply + the
     output-channel-major logits head) traced standalone — APX501 proves
@@ -1180,6 +1234,17 @@ def repo_entries() -> List[TraceEntry]:
                    _spec_verify_step_entry(tp=2),
                    checks=("precision", "memory", "schedule", "aliases"),
                    mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=4),
+        # tree-attention verify: one forward over a k1-node draft grid
+        # per slot (ancestor-matrix mask in place of the linear one);
+        # the donated 4-leaf paged cache is unchanged
+        TraceEntry("gpt_tree_verify_step", "apex_tpu.serving.decode",
+                   _tree_verify_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=4),
+        TraceEntry("gpt_tree_verify_step_tp2", "apex_tpu.serving.decode",
+                   _tree_verify_step_entry(tp=2),
+                   checks=("precision", "memory", "schedule", "aliases"),
+                   mesh=_mesh(tp=2), min_devices=2, min_alias_pairs=4),
         # cost-tier anchor for the BASELINE r8/r9 decode roofline; no
         # APX5xx checks (the tiny-shape decode entries above carry them
         # — this one exists so budgets.json pins the headline bytes)
@@ -1197,6 +1262,16 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_spec_verify_step_medium_ragged",
                    "apex_tpu.serving.decode",
                    _spec_verify_step_medium_ragged_entry(), checks=()),
+        # r13: the model drafter's per-token forward at the medium
+        # shape — the draft_bytes numerator of the break-even condition
+        # (BASELINE.md r13); its hand-tightened ceiling pins the draft
+        # under 3% of the target parameter read. The dense-cache
+        # donation (3 leaves) rides along.
+        TraceEntry("gpt_draft_forward_step",
+                   "apex_tpu.serving.draft_model",
+                   _draft_forward_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
         # int8 tier: the standalone dequant-fused matmuls, the w8+kv8
         # paged serving steps (6 donated cache leaves — pool k/v,
         # lengths, block tables, k/v scales), a tp2 dense-decode with

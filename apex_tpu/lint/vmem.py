@@ -421,7 +421,39 @@ def _paged_serving_cfg(which):
 
             fn = make_paged_verify_fn(cfg)
             return fn, (params, cache, _sds((2, 4), "int32"))
+        if which == "tree_verify":
+            from apex_tpu.serving.decode import make_paged_tree_verify_fn
+
+            fn = make_paged_tree_verify_fn(cfg)
+            return fn, (params, cache, _sds((2, 4), "int32"),
+                        _sds((2, 4), "int32"), _sds((2, 4, 4), "bool"))
         fn = make_paged_decode_fn(cfg)
+        return fn, (params, cache, _sds((2,), "int32"),
+                    _sds((2,), "bool"))
+
+    return build
+
+
+def _draft_forward_cfg():
+    """The model drafter's per-token forward (``draft_gpt_tiny`` over
+    its dense lockstep cache): XLA math today, so — like the paged
+    steps — registering it pins the trace and budget-checks any Pallas
+    kernel that later lands in the draft path."""
+    def build():
+        import functools as ft
+
+        import jax
+
+        from apex_tpu.models.gpt import draft_gpt_tiny, init_gpt
+        from apex_tpu.serving.cache import init_cache
+        from apex_tpu.serving.decode import make_decode_fn
+
+        cfg = draft_gpt_tiny()
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg), jax.random.PRNGKey(0))
+        # 32 + 5: the engine max_len plus DraftModel's catch-up chunk
+        cache = jax.eval_shape(ft.partial(init_cache, cfg, 2, 37))
+        fn = make_decode_fn(cfg)
         return fn, (params, cache, _sds((2,), "int32"),
                     _sds((2,), "bool"))
 
@@ -460,6 +492,11 @@ def repo_configs() -> List[Config]:
                        _paged_serving_cfg("decode")))
     cfgs.append(Config("gpt_spec_verify_step", "apex_tpu.serving.decode",
                        _paged_serving_cfg("verify")))
+    cfgs.append(Config("gpt_tree_verify_step", "apex_tpu.serving.decode",
+                       _paged_serving_cfg("tree_verify")))
+    cfgs.append(Config("gpt_draft_forward_step",
+                       "apex_tpu.serving.draft_model",
+                       _draft_forward_cfg()))
     return cfgs
 
 

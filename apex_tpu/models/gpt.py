@@ -112,6 +112,27 @@ def gpt_tiny() -> GPTConfig:
                      max_position_embeddings=64)
 
 
+def draft_gpt_tiny() -> GPTConfig:
+    """2-layer draft model pairing :func:`gpt_tiny` for speculative
+    serving: same vocab (draft tokens must be target tokens), a fraction
+    of the width/depth, and RoPE so the draft's reach is never bound by
+    a learned position table shorter than the target's."""
+    return GPTConfig(vocab_size=512, hidden_size=32, num_layers=2,
+                     num_heads=4, ffn_hidden_size=64,
+                     max_position_embeddings=128, use_rope=True)
+
+
+def draft_gpt_medium() -> GPTConfig:
+    """Draft model pairing :func:`gpt_medium` — the cost-model config
+    behind the ``gpt_draft_forward_step`` budget entry: its per-step HBM
+    traffic (params + draft cache) must stay under 3% of the target's
+    per-step parameter read, the amortization condition BASELINE r13
+    derives for model-draft break-even."""
+    return GPTConfig(vocab_size=50304, hidden_size=128, num_layers=2,
+                     num_heads=2, ffn_hidden_size=256,
+                     max_position_embeddings=1024, use_rope=True)
+
+
 # ---------------------------------------------------------------------------
 # init — full (unsharded) params; stacked on a leading layer axis
 # ---------------------------------------------------------------------------
@@ -568,11 +589,157 @@ def _block_verify_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
 
 
 # ---------------------------------------------------------------------------
+# tree verify: one forward scores a whole draft TREE (SpecInfer-style).
+# The linear `s <= pos + j` mask generalizes to an ancestor matrix: key
+# node i is visible to query node j iff i is an ancestor-of-or-equal-to
+# j in the draft tree, so logits row j equal a teacher-forced forward
+# over exactly j's root-to-node token path. The linear chain is the
+# special case anc[i, j] = (i <= j), depth[j] = j.
+# ---------------------------------------------------------------------------
+
+def _tree_score_mask(pos, anc, s_max):
+    """(b, 1, k1, k1) tree visibility lifted to the (b, 1, q=k1, s=s_max)
+    score layout: key position ``s`` is admitted for query node ``j``
+    iff ``s < pos`` (committed history — every node sees all of it) or
+    ``s`` holds window node ``i = s - pos`` with ``anc[b, i, j]`` set
+    (ancestor-or-self). ``anc`` is (b, k1, k1) bool with anc[j, j]
+    required True; rows beyond the window stay masked exactly like the
+    linear verify mask, preserving the rollback contract."""
+    b, k1, _ = anc.shape
+    s_idx = jnp.arange(s_max)
+    committed = s_idx[None, :] < pos[:, None]            # (b, s)
+    rel = s_idx[None, :] - pos[:, None]                  # (b, s)
+    in_win = (rel >= 0) & (rel < k1)
+    relc = jnp.clip(rel, 0, k1 - 1)
+    vis = jnp.take_along_axis(                           # (b, s, k1)
+        anc, jnp.broadcast_to(relc[:, :, None], (b, s_max, k1)), axis=1)
+    vis = committed[:, :, None] | (in_win[:, :, None] & vis)
+    return vis.transpose(0, 2, 1)[:, None]               # (b, 1, q, s)
+
+
+def _tree_verify_attention(q_k_v: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, pos: jax.Array,
+                           depth: jax.Array, anc: jax.Array,
+                           cfg: GPTConfig,
+                           rope_freqs: Optional[jax.Array]):
+    """Tree-mask verify attention against a per-slot KV cache.
+
+    ``q_k_v`` is (b, k1, 3*h_local) — the grid nodes' fused projection
+    in topological order (node 0 = the pending committed token, the
+    root every branch hangs off); ``depth`` (b, k1) int32 is each
+    node's depth below the committed history, so node j's ATTENTION /
+    RoPE position is ``pos + depth[j]`` while its PHYSICAL cache row
+    stays ``pos + j`` (distinct rows per node — siblings at one tree
+    depth share a position but must not share a row). ``anc`` (b, k1,
+    k1) bool is the ancestor-or-self matrix consumed by
+    :func:`_tree_score_mask`. Same write-then-attend rollback contract
+    as :func:`_verify_attention`: all k1 rows are written before any
+    mask admits them, and the host re-sends any committed token whose
+    row did not land contiguously (the forced-prefix rule in
+    ``scheduler._tree_tick``), so rejected branch rows are overwritten
+    before they are ever attended."""
+    b, k1, _ = q_k_v.shape
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, k1, hd)
+    if rope_freqs is not None:
+        tpos = pos[:, None] + depth                      # (b, k1)
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=tpos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=tpos)
+
+    def write(cache, new, p):
+        return lax.dynamic_update_slice(cache, new, (0, p, 0))
+
+    k_cache = jax.vmap(write)(k_cache, k.astype(k_cache.dtype), pos)
+    v_cache = jax.vmap(write)(v_cache, v.astype(v_cache.dtype), pos)
+    s_max = k_cache.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / math.sqrt(hd)
+    valid = _tree_score_mask(pos, anc, s_max)
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     v_cache.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, k1, -1), k_cache, v_cache
+
+
+def _block_tree_verify(lp, x, k_cache, v_cache, pos, depth, anc, cfg,
+                       rope_freqs, qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_verify` under the tree-attention mask."""
+    att, k_cache, v_cache = _tree_verify_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_cache, v_cache, pos, depth, anc, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_cache, v_cache
+
+
+def _paged_tree_verify_attention(q_k_v: jax.Array, k_pages: jax.Array,
+                                 v_pages: jax.Array,
+                                 block_tables: jax.Array, pos: jax.Array,
+                                 depth: jax.Array, anc: jax.Array,
+                                 cfg: GPTConfig,
+                                 rope_freqs: Optional[jax.Array]):
+    """:func:`_tree_verify_attention` over the PAGED pool: the k1
+    unrolled row scatters of :func:`_paged_verify_attention` (node j at
+    physical position ``pos + j``) with the ancestor-matrix score mask
+    and depth-indexed RoPE. Not offered for the int8 pool: an accepted
+    non-leftmost branch would require compacting quantized rows across
+    pages, re-rounding committed history at branch-dependent scales —
+    the engine pins linear spec for kv8 instead."""
+    b, k1, _ = q_k_v.shape
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    q, k, v = _split_qkv(q_k_v, hd)            # (b, nh_local, k1, hd)
+    if rope_freqs is not None:
+        tpos = pos[:, None] + depth                      # (b, k1)
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=tpos)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=tpos)
+    for j in range(k1):
+        p = pos + j
+        logical = jnp.clip(p // page_size, 0, block_tables.shape[1] - 1)
+        pages = jnp.take_along_axis(
+            block_tables, logical[:, None], 1)[:, 0]
+        rows = p % page_size
+        k_pages = k_pages.at[pages, :, rows].set(
+            k[:, :, j].astype(k_pages.dtype))
+        v_pages = v_pages.at[pages, :, rows].set(
+            v[:, :, j].astype(v_pages.dtype))
+    kg = k_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    vg = v_pages[block_tables].transpose(0, 2, 1, 3, 4)
+    s_max = kg.shape[2] * kg.shape[3]
+    kg = kg.reshape(b, kg.shape[1], s_max, hd)
+    vg = vg.reshape(b, vg.shape[1], s_max, hd)
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) / math.sqrt(hd)
+    valid = _tree_score_mask(pos, anc, s_max)
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     vg.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(b, k1, -1), k_pages, v_pages
+
+
+def _block_tree_verify_paged(lp, x, k_pages, v_pages, block_tables, pos,
+                             depth, anc, cfg, rope_freqs,
+                             qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_tree_verify` over the paged pool."""
+    att, k_pages, v_pages = _paged_tree_verify_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, block_tables, pos, depth, anc, cfg, rope_freqs)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages
+
+
+# ---------------------------------------------------------------------------
 # int8-quantized paged attention: RMW whole-page requant on write,
 # dequant inside the gather
 # ---------------------------------------------------------------------------
 
-def _q8_page_insert(pool, scale, pages, rows, new_row):
+def _q8_page_insert(pool, scale, pages, rows, new_row, rescale=True,
+                    zero_dead=False):
     """Insert ``new_row`` (b, nh, hd) fp32 into the int8 page ``pages``
     of each slot at row ``rows`` by a whole-page READ-MODIFY-WRITE
     requant: gather page + scale, dequantize, set the exact new row,
@@ -585,15 +752,51 @@ def _q8_page_insert(pool, scale, pages, rows, new_row):
     fixed scale is round-to-nearest idempotent, so untouched-amax pages
     come back bit-identical; an amax-raising row re-rounds the history
     at the new scale, which the teacher-forced tolerance gate covers.
-    Duplicate scatter targets only arise when several inactive slots
-    park on SCRATCH_PAGE — never attended, and a 0-or-positive scale
-    always dequantizes finite, so the nondeterminism can't escape."""
+
+    The VERIFY path passes ``zero_dead=True``: every row strictly
+    beyond the insert is zeroed before the amax (rows past the insert
+    point are stale/speculative garbage by the write-then-attend
+    contract, never admitted by any mask), making the new scale a pure
+    function of LIVE rows. That is what upgrades the kv8 spec stream
+    from tolerance-gated to bit-identical across rejected-tail
+    differences (two runs that committed the same tokens but drafted
+    different rejected tails requantize every page at identical
+    scales). The single-token decode step keeps the whole-tile amax —
+    its beyond-rows are zeros, stale-owner garbage (never attended,
+    about to be overwritten), or a rejected tail the next verify
+    window rewrites before any rescale — preserving r12's plain-tick
+    bit pattern exactly.
+
+    ``rescale=False`` (the speculative verify columns j >= 1) pins the
+    page's existing scale instead: the new row quantizes (clipped)
+    against it and every other row re-rounds at its own scale, which is
+    round-to-nearest idempotent — so a SPECULATIVE row can never
+    re-round committed history at a scale influenced by other (possibly
+    rejected) candidates. A row landing at page row 0 always resets the
+    scale (the page holds nothing live below it), which keeps fresh
+    pages usable mid-draft and is wiped by the next tick's writes if
+    the candidate is rejected. Duplicate scatter targets only arise
+    when several inactive slots park on SCRATCH_PAGE — never attended,
+    and a 0-or-positive scale always dequantizes finite, so the
+    nondeterminism can't escape."""
     from apex_tpu.quant.kernels import kv_dequantize, kv_quantize
 
     b = pages.shape[0]
-    tile = kv_dequantize(pool[pages], scale[pages])    # (b, nh, page, hd)
+    old = scale[pages]                                 # (b, nh)
+    tile = kv_dequantize(pool[pages], old)             # (b, nh, page, hd)
     tile = tile.at[jnp.arange(b), :, rows].set(new_row)
+    if zero_dead:
+        ridx = jnp.arange(tile.shape[2])
+        live = ridx[None, None, :, None] <= rows[:, None, None, None]
+        tile = jnp.where(live, tile, 0.0)
     nq, ns = kv_quantize(tile)
+    if not rescale:
+        keep = (rows > 0)[:, None]                     # (b, 1) over heads
+        sel = jnp.where(keep, old, ns)
+        safe = jnp.where(sel > 0, sel, 1.0)[..., None, None]
+        qk = jnp.clip(jnp.round(tile / safe), -127, 127).astype(pool.dtype)
+        nq = jnp.where(keep[..., None, None], qk, nq)
+        ns = sel
     return pool.at[pages].set(nq), scale.at[pages].set(ns)
 
 
@@ -665,11 +868,17 @@ def _paged_verify_attention_q8(q_k_v, k_pages, v_pages, k_scale, v_scale,
     """:func:`_paged_verify_attention` over the int8 pool: k1 unrolled
     whole-page RMW requants (consecutive candidates re-read the latest
     page state, so same-page candidates compose), then the dequantized
-    gather with the per-query ``s <= pos + j`` masks. NOTE: the RMW can
-    re-scale a page even for candidates the host later rejects, so a
-    kv8 spec stream is gated on the teacher-forced TOLERANCE, not
-    bit-identity — the exact Leviathan-accept bit-identity claim is for
-    int8 WEIGHTS over a bf16 cache (see docs/source/quantization.rst).
+    gather with the per-query ``s <= pos + j`` masks. Column 0 is the
+    pending COMMITTED token, so it may rescale its page (the amax runs
+    over live rows only — :func:`_q8_page_insert` zeroes the dead
+    tail); columns j >= 1 are speculative and write with
+    ``rescale=False``, pinning the page scale so rejected candidates
+    can never re-round committed history. Together these make later
+    logits on the int8 cache bit-identical across runs that differ
+    only in rejected draft tails (the kv8 spec-stream contract pinned
+    by ``test_quant.py::test_kv8_rejected_tails_do_not_perturb``);
+    spec-vs-PLAIN kv8 streams remain tolerance-gated, since plain
+    decode rescales at every step where verify pins mid-draft.
     """
     b, k1, _ = q_k_v.shape
     hd = cfg.head_dim
@@ -685,9 +894,13 @@ def _paged_verify_attention_q8(q_k_v, k_pages, v_pages, k_scale, v_scale,
             block_tables, logical[:, None], 1)[:, 0]
         rows = p % page_size
         k_pages, k_scale = _q8_page_insert(
-            k_pages, k_scale, pages, rows, k[:, :, j].astype(jnp.float32))
+            k_pages, k_scale, pages, rows,
+            k[:, :, j].astype(jnp.float32), rescale=(j == 0),
+            zero_dead=True)
         v_pages, v_scale = _q8_page_insert(
-            v_pages, v_scale, pages, rows, v[:, :, j].astype(jnp.float32))
+            v_pages, v_scale, pages, rows,
+            v[:, :, j].astype(jnp.float32), rescale=(j == 0),
+            zero_dead=True)
     kg = _q8_gather(k_pages, k_scale, block_tables, b, hd)
     vg = _q8_gather(v_pages, v_scale, block_tables, b, hd)
     s_max = kg.shape[2]

@@ -24,6 +24,7 @@ from apex_tpu.partition.rules import (
 )
 from apex_tpu.partition.tables import (
     bert_rules,
+    draft_gpt_rules,
     gpt_quant_rules,
     gpt_rules,
     kv_cache_quant_rules,
@@ -32,6 +33,7 @@ from apex_tpu.partition.tables import (
 
 __all__ = [
     "bert_rules",
+    "draft_gpt_rules",
     "gpt_quant_rules",
     "gpt_rules",
     "kv_cache_quant_rules",

@@ -107,6 +107,20 @@ def gpt_rules():
     ) + _KV_CACHE_RULES
 
 
+def draft_gpt_rules():
+    """Rule table for the speculative DRAFT model's param tree + its
+    lockstep KV cache (``serving.draft_model.DraftModel``). The draft
+    is a GPT sharded on the SAME mesh as the target, so the layout is
+    :func:`gpt_rules` minus the rows that can never match a draft tree:
+    draft configs (``models.gpt.draft_gpt_tiny``/``draft_gpt_medium``)
+    are RoPE-only — no ``embedding/position`` leaf — and the lockstep
+    draft cache is DENSE (``KVCache``: k/v/lengths, no block tables).
+    A rule that can never match would be an APX701 dead-rule finding
+    (the BERT table's KV-cache omission, same reasoning)."""
+    dead = ("embedding/position/embedding", r"(^|/)block_tables$")
+    return tuple(rule for rule in gpt_rules() if rule[0] not in dead)
+
+
 def bert_rules():
     """Rule table for the BERT param tree (``models.bert.init_bert``).
     BERT layers are a list (paths carry ``encoder/<i>/``), so patterns

@@ -16,7 +16,11 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   a TP-sharded path (heads over the ``model`` axis);
 - ``draft``     — host-side n-gram / prompt-lookup drafting for
   self-speculative decode (pure function of the token history — no
-  draft model, no device work);
+  draft model, no device work), plus the ``tree_arrays`` grid packer
+  for tree speculation;
+- ``draft_model`` — model-based drafting: a tiny (optionally
+  TP-sharded) draft GPT advanced in lockstep with the target's slots,
+  re-synced by common prefix after rejections;
 - ``sampling``  — greedy / temperature / top-k / top-p under explicit
   PRNG keys, including the speculative accept/resample grid whose
   committed stream is bit-identical to plain decode;
@@ -25,7 +29,9 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   over either engine; the paged engine adds prefix sharing at admission
   and preemption-by-requeue when the pool runs dry; ``spec_k > 0``
   turns ticks into draft → verify → accept steps committing 1..k+1
-  tokens per slot;
+  tokens per slot, with optional model drafting (``draft_model=``),
+  tree speculation (``tree_spec=True``) and per-stream adaptive depth
+  (``adaptive_spec=True``);
 - ``health``    — typed failure taxonomy (``PoolExhausted``,
   ``NonFiniteLogits``, ``RetryBudgetExhausted``, ...), per-engine
   ``ServingStats`` counters, and typed ``RequestOutcome`` records;
@@ -41,12 +47,15 @@ from apex_tpu.serving.cache import (  # noqa: F401
 )
 from apex_tpu.serving.decode import (  # noqa: F401
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
-    make_paged_prefill_fn, make_paged_verify_fn, make_prefill_fn,
-    make_tp_decode_fn, make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
-    make_tp_paged_verify_fn, make_tp_prefill_fn, make_tp_verify_fn,
-    make_verify_fn,
+    make_paged_prefill_fn, make_paged_tree_verify_fn,
+    make_paged_verify_fn, make_prefill_fn, make_tp_decode_fn,
+    make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
+    make_tp_paged_tree_verify_fn, make_tp_paged_verify_fn,
+    make_tp_prefill_fn, make_tp_tree_verify_fn, make_tp_verify_fn,
+    make_tree_verify_fn, make_verify_fn,
 )
-from apex_tpu.serving.draft import ngram_draft  # noqa: F401
+from apex_tpu.serving.draft import ngram_draft, tree_arrays  # noqa: F401
+from apex_tpu.serving.draft_model import DraftModel  # noqa: F401
 from apex_tpu.serving.faults import (  # noqa: F401
     SITES, FaultInjector, InjectedFault, fault_draw,
 )
@@ -58,6 +67,7 @@ from apex_tpu.serving.health import (  # noqa: F401
 from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
 from apex_tpu.serving.sampling import (  # noqa: F401
     finite_rows, sample_token_grid, sample_tokens, speculative_accept,
+    tree_speculative_accept,
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,

@@ -32,6 +32,17 @@ Contracts:
   rejected candidate's row is simply never admitted by any later mask
   before the next step re-writes it. That is the whole rollback
   contract, and it is pinned by bit-identity tests.
+- **tree verify** generalizes verify to a draft TREE per slot: node j
+  (topological order, node 0 = the pending token) writes its K/V at
+  physical row ``pos + j`` but attends at position ``pos + depth[j]``
+  under an ancestor-matrix mask, so logits row j is the exact
+  teacher-forced distribution over j's root-to-node token path — one
+  forward scores every branch (SpecInfer-style). Lengths are NOT
+  advanced; the host walks the accepted path
+  (``sampling.tree_speculative_accept``) and advances only the
+  row-CONTIGUOUS committed prefix, re-sending any committed token
+  whose row landed off the leftmost chain (the forced-prefix rule) —
+  the same write-then-attend rollback, no compaction pass.
 - both jitted steps DONATE the cache: the update lowers to an in-place
   buffer write instead of a fresh ``O(L·B·H·S·d)`` copy per token.
   APX512 (trace tier) verifies the donation survives into the jaxpr.
@@ -43,9 +54,9 @@ from jax import lax
 
 from apex_tpu.models.gpt import (
     GPTConfig, GPTModel, _block_decode, _block_decode_paged,
-    _block_decode_paged_q8, _block_prefill, _block_verify,
-    _block_verify_paged, _block_verify_paged_q8, _ln,
-    _rope_or_none, _tied_lm_logits,
+    _block_decode_paged_q8, _block_prefill, _block_tree_verify,
+    _block_tree_verify_paged, _block_verify, _block_verify_paged,
+    _block_verify_paged_q8, _ln, _rope_or_none, _tied_lm_logits,
 )
 from apex_tpu.serving.cache import (
     KVCache, PagedKVCache, cache_partition_specs,
@@ -148,6 +159,34 @@ def _verify_core(params, cfg: GPTConfig, cache: KVCache, tokens, *,
         lp, kc, vc = layer_slice
         x, kc, vc = _block_verify(lp, x, kc, vc, pos, cfg, freqs,
                                   *dense_fns)
+        return x, (kc, vc)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden)
+    return KVCache(k, v, _self_rewrite(pos)), logits
+
+
+def _tree_verify_core(params, cfg: GPTConfig, cache: KVCache, tokens,
+                      depth, anc, *, embed_fn, dense_fns, logits_fn):
+    """Tree verify: tokens (B, k1) int32 in topological order (column 0
+    = each slot's pending token, the root every branch hangs off);
+    depth (B, k1) int32 node depths (depth[0] = 0); anc (B, k1, k1)
+    bool ancestor-or-self matrix (anc[i, j]: node i on j's root path,
+    anc[j, j] = True; a linear chain is anc[i, j] = i <= j with
+    depth[j] = j, which reduces this exactly to :func:`_verify_core`).
+    Node j's position embedding/RoPE angle is ``lengths + depth[j]``
+    and logits row j is the teacher-forced distribution following j's
+    root-to-node path. Lengths are NOT advanced — the host walks the
+    accepted path and commits the contiguous row prefix."""
+    pos = cache.lengths
+    x = embed_fn(params, tokens, pos=pos[:, None] + depth)
+    freqs = _rope_or_none(cfg, cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kc, vc = layer_slice
+        x, kc, vc = _block_tree_verify(lp, x, kc, vc, pos, depth, anc,
+                                       cfg, freqs, *dense_fns)
         return x, (kc, vc)
 
     x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
@@ -323,9 +362,48 @@ def _paged_verify_core(params, cfg: GPTConfig, cache: PagedKVCache,
         logits
 
 
+def _paged_tree_verify_core(params, cfg: GPTConfig, cache: PagedKVCache,
+                            tokens, depth, anc, *, embed_fn, dense_fns,
+                            logits_fn):
+    """:func:`_tree_verify_core` over the page pool (same
+    ``prepare_decode(..., n_new=k1)`` exclusivity precondition as
+    :func:`_paged_verify_core`). Refused for the int8 pool: committing
+    a non-leftmost branch would re-round quantized history at
+    branch-dependent scales, breaking the kv8 rejected-tail
+    bit-identity contract — the engine pins linear spec there."""
+    if cache.k_scale is not None:
+        raise ValueError("tree verify is not offered over the int8 page "
+                         "pool (kv8 keeps linear speculation)")
+    pos = cache.lengths
+    bt = cache.block_tables
+    x = embed_fn(params, tokens, pos=pos[:, None] + depth)
+    freqs = _rope_or_none(cfg, bt.shape[1] * cache.k.shape[3])
+
+    def body(x, layer_slice):
+        lp, kp, vp = layer_slice
+        x, kp, vp = _block_tree_verify_paged(
+            lp, x, kp, vp, bt, pos, depth, anc, cfg, freqs, *dense_fns)
+        return x, (kp, vp)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    logits = logits_fn(params, hidden)
+    return PagedKVCache(k, v, _self_rewrite(pos), _self_rewrite(bt)), \
+        logits
+
+
 # ---------------------------------------------------------------------------
 # unsharded (single-chip) builders
 # ---------------------------------------------------------------------------
+
+def _pos_idx(pos, s):
+    """(b, s) absolute position indices from either a (b,) start (the
+    decode/verify convention: consecutive from ``pos``) or an explicit
+    (b, s) array (tree verify: ``pos + depth``, not consecutive)."""
+    if pos.ndim == 2:
+        return pos
+    return pos[:, None] + jnp.arange(s)[None, :]
+
 
 def _dense(p, x):
     return jnp.dot(x, p["kernel"].astype(x.dtype)) \
@@ -344,8 +422,9 @@ def _embed_unsharded(cfg: GPTConfig, compute_dtype):
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
                 # decode/verify: slot b's s tokens sit at absolute
-                # positions pos[b], pos[b]+1, ... (s = 1 for decode)
-                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                # positions pos[b], pos[b]+1, ... (s = 1 for decode);
+                # tree verify passes explicit (b, s) positions
+                idx = _pos_idx(pos, ids.shape[1])
                 x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
     return embed
@@ -382,7 +461,7 @@ def _embed_w8(cfg: GPTConfig, compute_dtype):
             if pos is None:
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
-                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                idx = _pos_idx(pos, ids.shape[1])
                 x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
 
@@ -503,6 +582,39 @@ def make_paged_verify_fn(cfg: GPTConfig, compute_dtype=None,
     return jax.jit(verify, donate_argnums=1)
 
 
+def make_tree_verify_fn(cfg: GPTConfig, compute_dtype=None,
+                        quantized=False):
+    """jit(tree verify) with the cache DONATED; one executable per
+    (cache shape, k1). Takes (params, cache, tokens (B, k1), depth
+    (B, k1) int32, anc (B, k1, k1) bool) — see
+    :func:`_tree_verify_core` for the node contract."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
+
+    def verify(params, cache, tokens, depth, anc):
+        return _tree_verify_core(params, cfg, cache, tokens, depth, anc,
+                                 embed_fn=embed, dense_fns=dense_fns,
+                                 logits_fn=logits_fn)
+
+    return jax.jit(verify, donate_argnums=1)
+
+
+def make_paged_tree_verify_fn(cfg: GPTConfig, compute_dtype=None,
+                              quantized=False):
+    """jit(paged tree verify), cache DONATED (4 alias pairs). Int8
+    pools are refused — see :func:`_paged_tree_verify_core`."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
+
+    def verify(params, cache, tokens, depth, anc):
+        return _paged_tree_verify_core(params, cfg, cache, tokens,
+                                       depth, anc, embed_fn=embed,
+                                       dense_fns=dense_fns,
+                                       logits_fn=logits_fn)
+
+    return jax.jit(verify, donate_argnums=1)
+
+
 def make_copy_page_fn():
     """jit(copy one physical page across all layers), cache DONATED —
     the device half of copy-on-write: the host picks ``src``/``dst``
@@ -543,7 +655,7 @@ def _tp_fns(model: GPTModel):
             if pos is None:
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
-                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                idx = _pos_idx(pos, ids.shape[1])
                 x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
 
@@ -596,7 +708,7 @@ def _tp_quant_fns(model: GPTModel):
             if pos is None:
                 x = x + ptab[: ids.shape[1]].astype(x.dtype)[None]
             else:
-                idx = pos[:, None] + jnp.arange(ids.shape[1])[None, :]
+                idx = _pos_idx(pos, ids.shape[1])
                 x = x + jnp.take(ptab, idx, axis=0).astype(x.dtype)
         return x
 
@@ -768,5 +880,55 @@ def make_tp_paged_verify_fn(model: GPTModel, mesh=None, quantized=False,
     sharded = ps.shard_map(
         verify, mesh=mesh,
         in_specs=(pspecs, cspecs, P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_tree_verify_fn(model: GPTModel, mesh=None, quantized=False):
+    """TP tree verify: the depth/anc tree descriptors are replicated
+    host decisions (like block tables); heads shard over ``model`` and
+    the (b, k1, V) logits leave through the vocab-sharded head +
+    rank-order gather, exactly as :func:`make_tp_verify_fn`."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = cache_partition_specs()
+
+    def verify(params, cache, tokens, depth, anc):
+        return _tree_verify_core(params, cfg, cache, tokens, depth, anc,
+                                 embed_fn=embed, dense_fns=dense_fns,
+                                 logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        verify, mesh=mesh,
+        in_specs=(pspecs, cspecs, P(), P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_paged_tree_verify_fn(model: GPTModel, mesh=None,
+                                 quantized=False):
+    """TP paged tree verify (int8 pools refused — linear spec only
+    there, so no ``kv_quantized`` switch)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = paged_cache_partition_specs()
+
+    def verify(params, cache, tokens, depth, anc):
+        return _paged_tree_verify_core(params, cfg, cache, tokens,
+                                       depth, anc, embed_fn=embed,
+                                       dense_fns=dense_fns,
+                                       logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        verify, mesh=mesh,
+        in_specs=(pspecs, cspecs, P(), P(), P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)

@@ -29,10 +29,15 @@ executables stay fault-free):
                    always-on non-finite quarantine path
 ``sample``         one slot's sampled token is replaced with an
                    out-of-vocabulary id — exercises token validation
-``draft_exec``     one slot's n-gram draft raises :class:`InjectedFault`
-                   — the scheduler degrades that slot to an empty draft
-                   (plain decode pace) for the tick, charging no retry
-                   budget; the stream stays bit-identical
+``draft_exec``     drafting fails. N-gram engines draw once per slot and
+                   degrade that slot to an empty draft (plain decode
+                   pace) for the tick. Engines with a model drafter
+                   degrade down a LADDER: the first fired draw falls
+                   back from the model draft to the n-gram draft for the
+                   whole batch, and a second fired draw on the SAME tick
+                   raises :class:`InjectedFault` — the scheduler empties
+                   every draft (plain tick). No rung charges retry
+                   budget; the stream stays bit-identical throughout
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from

@@ -140,6 +140,8 @@ class ServingStats:
     tokens_drafted: int = 0        # speculative candidates proposed
     tokens_accepted: int = 0       # drafted candidates that committed
     draft_faults: int = 0          # draft_exec faults (degraded ticks)
+    spec_ticks: int = 0            # verify-step ticks (linear or tree)
+    plain_ticks: int = 0           # single-token decode ticks
 
     @property
     def acceptance_rate(self) -> float:

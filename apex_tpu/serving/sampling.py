@@ -32,6 +32,7 @@ output is bit-identical to plain decode.
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def _restrict(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
@@ -106,6 +107,54 @@ def speculative_accept(tokens: jax.Array, drafts: jax.Array,
         (jnp.arange(k)[None, :] < draft_lens[:, None])
     return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
                    axis=1).astype(jnp.int32)
+
+
+def tree_speculative_accept(samples: jax.Array, tokens: jax.Array,
+                            parents: jax.Array, valid: jax.Array,
+                            start=None):
+    """:func:`speculative_accept` generalized to a draft TREE: walk the
+    accepted root-to-leaf path. ``samples`` (B, k1) are the tree-verify
+    grid's sampled tokens (node j drawn with the plain stream's key for
+    depth ``depth[j]``); ``tokens`` (B, k1) the grid's INPUT tokens;
+    ``parents`` (B, k1) int32 each node's parent grid index; ``valid``
+    (B, k1) bool marks candidate draft nodes (forced/pad columns
+    False); ``start`` (B,) is the walk root — the last forced column,
+    whose sample is the stream's first new token.
+
+    From ``cur = start``: commit ``samples[cur]``; descend to the valid
+    child whose INPUT token equals the committed sample (drafter
+    contract: children of one node carry distinct tokens, so the draw
+    lands on at most one branch — the point-mass Leviathan accept per
+    branch); stop when no child matches. Returns (counts (B,) int32 —
+    committed tokens, in [1, k1]; path (B, k1) int32 — visited node
+    indices, -1 beyond the path). The committed tokens are
+    ``samples[b, path[b, i]]`` in path order: each visited node's
+    sample is drawn with exactly the key and (teacher-forced)
+    distribution the plain stream would use, so the committed stream
+    stays bit-identical to plain decode — acceptance only changes how
+    many steps it takes."""
+    b, k1 = samples.shape
+    if start is None:
+        start = jnp.zeros((b,), jnp.int32)
+    idx = jnp.arange(k1)[None, :]
+
+    def step(carry, _):
+        cur, alive = carry
+        s = jnp.take_along_axis(samples, cur[:, None], 1)[:, 0]
+        cand = valid & (parents == cur[:, None]) & \
+            (tokens == s[:, None]) & (idx > cur[:, None])
+        has = jnp.any(cand, axis=1)
+        nxt = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        out = jnp.where(alive, cur, -1)
+        alive = alive & has
+        cur = jnp.where(alive, nxt, cur)
+        return (cur, alive), out
+
+    init = (start.astype(jnp.int32), jnp.ones((b,), bool))
+    _, path = lax.scan(step, init, None, length=k1)
+    path = path.T                                        # (B, k1)
+    counts = jnp.sum(path >= 0, axis=1).astype(jnp.int32)
+    return counts, path
 
 
 def finite_rows(logits: jax.Array) -> jax.Array:

@@ -31,6 +31,29 @@ comparable between modes. The tick degrades to a plain decode step
 whenever every draft is empty (including a fired ``draft_exec`` fault
 site) or any active slot lacks ``spec_k + 1`` rows of cache headroom.
 
+Model-based & tree speculation (PR 12) layer three upgrades onto that
+base, each independently switchable and all preserving the committed
+streams bit-for-bit:
+
+- **model drafting** (``draft_model=``): a tiny TP-sharded draft GPT
+  (``serving.draft_model.DraftModel``) replaces the n-gram lookup,
+  advanced in lockstep with the target's slots and re-synced by common
+  prefix after rejections. Its ``draft_exec`` fault ladder degrades
+  model draft → n-gram draft → plain tick, charging no retry budget.
+- **tree speculation** (``tree_spec=True``): drafts become small trees
+  (chain + alternate root branch) verified in ONE tree-attention
+  forward (``decode.make_tree_verify_fn``); the accept walk
+  (``sampling.tree_speculative_accept``) follows the sampled
+  root-to-leaf path. Cache lengths only ever advance by the
+  row-contiguous committed prefix; committed tokens stranded off the
+  leftmost chain are RE-SENT as next tick's forced chain (the
+  forced-prefix rule — bounded by tree depth, never compounding).
+- **adaptive depth** (``adaptive_spec=True``): a per-stream EWMA of
+  the measured acceptance rate scales each slot's draft depth between
+  0 (plain ticks, with a periodic probe) and ``spec_k``, and the
+  verify grid narrows to the widest draft actually proposed — so a
+  stream that stops accepting stops paying for speculation.
+
 Failure is an expected state (the dynamic-loss-scaler discipline,
 applied to serving — see ``serving.health``): pool exhaustion, NaN
 logits, bad samples, and transient exec faults all degrade gracefully
@@ -92,10 +115,11 @@ from apex_tpu.serving.cache import (
 )
 from apex_tpu.serving.decode import (
     make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
-    make_paged_prefill_fn, make_paged_verify_fn, make_prefill_fn,
+    make_paged_prefill_fn, make_paged_tree_verify_fn,
+    make_paged_verify_fn, make_prefill_fn, make_tree_verify_fn,
     make_verify_fn,
 )
-from apex_tpu.serving.draft import ngram_draft
+from apex_tpu.serving.draft import ngram_draft, tree_arrays
 from apex_tpu.serving.faults import FaultInjector, InjectedFault
 from apex_tpu.serving.health import (
     AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
@@ -105,6 +129,7 @@ from apex_tpu.quant.params import is_quantized_tree
 from apex_tpu.serving.paging import PagePool, prefix_page_keys
 from apex_tpu.serving.sampling import (
     finite_rows, sample_token_grid, sample_tokens,
+    tree_speculative_accept,
 )
 from apex_tpu.utils.seqlen import bucket_for, default_buckets, pad_to_bucket
 
@@ -150,7 +175,9 @@ class DecodeEngine:
                  top_p: float = 0.0, spec_k: int = 0,
                  buckets: Optional[Sequence[int]] = None,
                  compute_dtype=None,
-                 injector: Optional[FaultInjector] = None):
+                 injector: Optional[FaultInjector] = None,
+                 draft_model=None, tree_spec: bool = False,
+                 adaptive_spec: bool = False):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -164,6 +191,10 @@ class DecodeEngine:
         self.top_k = top_k
         self.top_p = top_p
         self.spec_k = spec_k
+        self._check_spec_config(draft_model, tree_spec, adaptive_spec)
+        self.draft_model = draft_model
+        self.tree_spec = tree_spec
+        self.adaptive_spec = adaptive_spec
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
         if jnp.dtype(cache_dtype) == jnp.int8:
@@ -177,7 +208,27 @@ class DecodeEngine:
         self._prefill = make_prefill_fn(cfg, compute_dtype, quantized)
         self._decode = make_decode_fn(cfg, compute_dtype, quantized)
         self._verify = make_verify_fn(cfg, compute_dtype, quantized)
+        self._tree_verify = make_tree_verify_fn(
+            cfg, compute_dtype, quantized) if tree_spec else None
         self._init_samplers()
+
+    def _check_spec_config(self, draft_model, tree_spec,
+                           adaptive_spec) -> None:
+        if (draft_model is not None or tree_spec or adaptive_spec) \
+                and self.spec_k < 1:
+            raise ValueError(
+                "draft_model / tree_spec / adaptive_spec require "
+                "spec_k >= 1 (speculation is otherwise disabled)")
+        if draft_model is not None:
+            if draft_model.num_slots != self.num_slots:
+                raise ValueError(
+                    f"draft model has {draft_model.num_slots} slots, "
+                    f"engine has {self.num_slots}")
+            if draft_model.cfg.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocabulary "
+                    f"({draft_model.cfg.vocab_size} vs "
+                    f"{self.cfg.vocab_size})")
 
     def _init_samplers(self) -> None:
         self._sample = jax.jit(sample_tokens,
@@ -251,6 +302,53 @@ class DecodeEngine:
                                 self.injector.calls("draft_exec") - 1)
         return ngram_draft(history, self.spec_k)
 
+    def _draft_ladder(self) -> bool:
+        """The model drafter's two-rung ``draft_exec`` ladder: one draw
+        decides whether the MODEL draft fails this tick; a fired draw
+        counts a draft fault and takes a second draw deciding whether
+        the n-gram fallback fails too (raising :class:`InjectedFault`,
+        which the scheduler turns into a plain tick). Returns True when
+        the caller should use the n-gram rung. No rung charges retry
+        budget — drafting is best-effort."""
+        fired, _ = self.injector.draw("draft_exec")
+        if not fired:
+            return False
+        self.stats.draft_faults += 1
+        fired, _ = self.injector.draw("draft_exec")
+        if fired:
+            raise InjectedFault("draft_exec",
+                                self.injector.calls("draft_exec") - 1)
+        return True
+
+    def draft_batch(self, histories, ks) -> List[List[int]]:
+        """Model-draft every slot in ONE batched call: up to ``ks[i]``
+        greedy continuation tokens of ``histories[i]`` from the
+        attached :class:`~apex_tpu.serving.draft_model.DraftModel`
+        (``None`` history or ``k = 0`` yields an empty draft). The
+        ``draft_exec`` ladder (:meth:`_draft_ladder`) degrades model →
+        n-gram → plain."""
+        if self._draft_ladder():
+            return [list(ngram_draft(h, k)) if h is not None else []
+                    for h, k in zip(histories, ks)]
+        return [[int(t) for t in c]
+                for c in self.draft_model.draft(histories, ks)]
+
+    def draft_tree_batch(self, histories, ks):
+        """Tree drafts (``(tokens, parents)`` per slot, ``None`` when
+        inactive) from the model drafter — a greedy chain plus an
+        alternate root branch, see :meth:`DraftModel.draft_tree`. The
+        same ``draft_exec`` ladder applies; its n-gram rung emits
+        single-chain trees."""
+        if self._draft_ladder():
+            out = []
+            for h, k in zip(histories, ks):
+                c = [int(t) for t in ngram_draft(h, k)] \
+                    if h is not None else []
+                out.append((c, [-1] + list(range(len(c) - 1)))
+                           if c else None)
+            return out
+        return self.draft_model.draft_tree(histories, ks)
+
     def verify(self, tokens: jax.Array) -> jax.Array:
         """One speculative verify step: ``tokens`` (num_slots, spec_k+1)
         int32 — column 0 the pending token, columns 1.. the (0-padded)
@@ -261,6 +359,23 @@ class DecodeEngine:
         positions, post-jit)."""
         self.cache, logits = self._verify(self.params, self.cache,
                                           tokens)
+        fired, payload = self.injector.draw("decode_exec")
+        if fired:
+            victim = int(payload % logits.shape[0])
+            logits = logits.at[victim].set(jnp.nan)
+        return logits
+
+    def tree_verify(self, tokens: jax.Array, depth: jax.Array,
+                    anc: jax.Array) -> jax.Array:
+        """One tree-attention verify step over a packed draft grid (see
+        :func:`~apex_tpu.serving.draft.tree_arrays`): column j writes
+        K/V at physical row ``lengths + j`` with sequence position
+        ``lengths + depth[:, j]`` and attends committed rows plus its
+        ancestor columns under ``anc``. Returns (num_slots, k1, V) fp32
+        logits; commits stay host-side (:meth:`commit`). Shares the
+        ``decode_exec`` fault site with the other step kinds."""
+        self.cache, logits = self._tree_verify(self.params, self.cache,
+                                               tokens, depth, anc)
         fired, payload = self.injector.draw("decode_exec")
         if fired:
             victim = int(payload % logits.shape[0])
@@ -302,7 +417,10 @@ class DecodeEngine:
         return []
 
     def free_slot(self, slot: int) -> None:
-        """Release slot-owned resources on eviction/preemption."""
+        """Release slot-owned resources on eviction/preemption (the
+        attached draft model's lockstep cache row, when present)."""
+        if self.draft_model is not None:
+            self.draft_model.free_slot(slot)
 
     def check_invariants(self) -> bool:
         """Audit engine-owned bookkeeping (pool refcounts, block
@@ -340,7 +458,9 @@ class PagedDecodeEngine(DecodeEngine):
                  compute_dtype=None,
                  free_order: Optional[Sequence[int]] = None,
                  prefix_sharing: bool = True,
-                 injector: Optional[FaultInjector] = None):
+                 injector: Optional[FaultInjector] = None,
+                 draft_model=None, tree_spec: bool = False,
+                 adaptive_spec: bool = False):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -360,6 +480,15 @@ class PagedDecodeEngine(DecodeEngine):
         self.top_k = top_k
         self.top_p = top_p
         self.spec_k = spec_k
+        self._check_spec_config(draft_model, tree_spec, adaptive_spec)
+        if tree_spec and jnp.dtype(cache_dtype) == jnp.int8:
+            raise ValueError(
+                "tree verify is not offered over the int8 page pool: a "
+                "branch commit would re-round committed history at "
+                "branch-dependent scales; kv8 keeps linear speculation")
+        self.draft_model = draft_model
+        self.tree_spec = tree_spec
+        self.adaptive_spec = adaptive_spec
         self.injector = injector or FaultInjector()
         self.stats = ServingStats()
         # both quantization levers are independent: weight-only int8 is
@@ -377,6 +506,8 @@ class PagedDecodeEngine(DecodeEngine):
                                               quantized)
         self._decode = make_paged_decode_fn(cfg, compute_dtype, quantized)
         self._verify = make_paged_verify_fn(cfg, compute_dtype, quantized)
+        self._tree_verify = make_paged_tree_verify_fn(
+            cfg, compute_dtype, quantized) if tree_spec else None
         self._copy = make_copy_page_fn()
         self._init_samplers()
 
@@ -519,6 +650,8 @@ class PagedDecodeEngine(DecodeEngine):
         self.cache = self.cache._replace(
             block_tables=self.cache.block_tables.at[slot].set(
                 jnp.full((self.max_pages,), SCRATCH_PAGE, jnp.int32)))
+        if self.draft_model is not None:
+            self.draft_model.free_slot(slot)
 
     def check_invariants(self) -> bool:
         """Full pool audit: host-side refcount/free-list/registry
@@ -567,6 +700,13 @@ class ContinuousBatchingScheduler:
         # n_generated[b] + j — the plain stream's key for that token
         self._fold_grid = jax.jit(jax.vmap(
             jax.vmap(jax.random.fold_in, (None, 0)), (0, 0)))
+        self._tree_accept = jax.jit(tree_speculative_accept)
+        # adaptive controller state: per-slot EWMA of the measured
+        # draft acceptance rate (reset to optimistic 1.0 at admission);
+        # converged-off slots get one probe draft every _probe_every
+        # ticks so repetitive text can re-earn its depth
+        self._accept_ewma = [1.0] * engine.num_slots
+        self._probe_every = 16
 
     def submit(self, request: Request) -> int:
         if self.max_queue is not None \
@@ -739,6 +879,7 @@ class ContinuousBatchingScheduler:
                 slot.generated.append(first_tok)
                 self._tokens_emitted += 1
             self._slots[i] = slot
+            self._accept_ewma[i] = 1.0
             self._maybe_evict(i)
 
     def _fail_admission(self, i: int, rid: int, err) -> bool:
@@ -761,7 +902,10 @@ class ContinuousBatchingScheduler:
             reason = "eos"
         elif len(slot.generated) >= slot.request.max_new_tokens:
             reason = "length"
-        elif slot.pos >= self.engine.max_len:  # cache row full
+        elif slot.prompt_len + len(slot.generated) > self.engine.max_len:
+            # cache row full: the committed stream no longer fits even
+            # after a tree tick's forced-chain catch-up (in plain mode
+            # this reduces to the classic ``pos >= max_len``)
             reason = "cache_full"
         else:
             return
@@ -770,24 +914,85 @@ class ContinuousBatchingScheduler:
         self._slots[i] = None
         self.engine.free_slot(i)
 
-    def _draft_all(self) -> List[List[int]]:
-        """One draft per slot (empty for free slots and fired
-        ``draft_exec`` sites — drafting is best-effort, so a fault
-        degrades the slot to plain pace without charging its retry
-        budget)."""
+    def _spec_ks(self, positions: Dict[int, int]) -> List[int]:
+        """Per-slot draft depth for this tick. Fixed engines always ask
+        for ``spec_k``; adaptive engines scale it by the slot's
+        acceptance EWMA (rounding to 0 turns the slot's speculation
+        off entirely), with a periodic probe draft so a stream whose
+        text turns predictable again can re-earn its depth."""
+        eng = self.engine
+        ks = [0] * eng.num_slots
+        for i in positions:
+            if not eng.adaptive_spec:
+                ks[i] = eng.spec_k
+                continue
+            k = int(round(self._accept_ewma[i] * eng.spec_k))
+            if k <= 0 and self._tick_no % self._probe_every == 0:
+                k = 1
+            ks[i] = max(0, min(k, eng.spec_k))
+        return ks
+
+    def _histories(self, ks: List[int]) -> List[Optional[Tuple[int, ...]]]:
+        return [tuple(s.request.prompt) + tuple(s.generated)
+                if s is not None and ks[i] > 0 else None
+                for i, s in enumerate(self._slots)]
+
+    def _draft_all(self, ks: List[int]) -> List[List[int]]:
+        """One linear draft per slot, up to ``ks[i]`` tokens deep
+        (empty for free slots, depth-0 slots, and fired ``draft_exec``
+        sites — drafting is best-effort, so a fault degrades to plain
+        pace without charging retry budget; model-drafter engines
+        degrade down the ladder in
+        :meth:`DecodeEngine.draft_batch`)."""
+        eng = self.engine
+        hists = self._histories(ks)
+        if eng.draft_model is not None:
+            try:
+                return eng.draft_batch(hists, ks)
+            except InjectedFault:
+                self.stats.draft_faults += 1
+                return [[] for _ in self._slots]
         drafts: List[List[int]] = []
-        for s in self._slots:
-            if s is None:
+        for i, h in enumerate(hists):
+            if h is None:
                 drafts.append([])
                 continue
             try:
-                d = self.engine.draft(
-                    tuple(s.request.prompt) + tuple(s.generated))
+                d = self.engine.draft(h)
             except InjectedFault:
                 self.stats.draft_faults += 1
                 d = []
-            drafts.append([int(t) for t in d])
+            drafts.append([int(t) for t in d[:ks[i]]])
         return drafts
+
+    def _draft_trees(self, ks: List[int]):
+        """One draft tree per slot (``None`` for free slots, depth-0
+        slots, and fault-degraded ticks). Model-drafter engines walk
+        the ``draft_exec`` ladder in
+        :meth:`DecodeEngine.draft_tree_batch`; n-gram engines chain
+        their linear drafts as single-branch trees."""
+        eng = self.engine
+        hists = self._histories(ks)
+        if eng.draft_model is not None:
+            try:
+                return eng.draft_tree_batch(hists, ks)
+            except InjectedFault:
+                self.stats.draft_faults += 1
+                return [None] * eng.num_slots
+        trees = []
+        for i, h in enumerate(hists):
+            if h is None:
+                trees.append(None)
+                continue
+            try:
+                d = self.engine.draft(h)
+            except InjectedFault:
+                self.stats.draft_faults += 1
+                d = []
+            d = [int(t) for t in d[:ks[i]]]
+            trees.append((d, [-1] + list(range(len(d) - 1)))
+                         if d else None)
+        return trees
 
     def _tick(self) -> None:
         eng = self.engine
@@ -798,21 +1003,36 @@ class ContinuousBatchingScheduler:
         # original stream bit-for-bit)
         positions = {i: s.pos for i, s in enumerate(self._slots)
                      if s is not None}
-        # speculate only when EVERY active slot has spec_k + 1 rows of
-        # headroom (a clamped out-of-range cache write would shift onto
-        # committed rows) and some draft is non-empty; otherwise this
-        # tick is a plain decode step — the k=0 degradation the chaos
-        # tier leans on
-        drafts = self._draft_all() if eng.spec_k > 0 else None
-        spec = bool(drafts is not None and positions
-                    and all(pos + eng.spec_k + 1 <= eng.max_len
-                            for pos in positions.values())
-                    and any(drafts[i] for i in positions))
+        if eng.tree_spec and eng.spec_k > 0 and positions:
+            if self._tree_tick(positions):
+                return
+            # every forced chain was trivial and no draft survived —
+            # fall through to a plain decode step
+            drafts, spec, k1 = None, False, 1
+        else:
+            # speculate only when EVERY active slot has k1 rows of
+            # headroom (a clamped out-of-range cache write would shift
+            # onto committed rows) and some draft is non-empty;
+            # otherwise this tick is a plain decode step — the k=0
+            # degradation the chaos tier leans on. Fixed engines always
+            # verify at the compiled spec_k + 1 width; adaptive ones
+            # narrow to 1 + the widest draft actually proposed, so the
+            # per-tick page charge below tracks the controller.
+            drafts = self._draft_all(self._spec_ks(positions)) \
+                if eng.spec_k > 0 and positions else None
+            k1 = eng.spec_k + 1
+            if drafts is not None and eng.adaptive_spec:
+                k1 = 1 + max((len(drafts[i]) for i in positions),
+                             default=0)
+            spec = bool(drafts is not None and k1 > 1
+                        and all(pos + k1 <= eng.max_len
+                                for pos in positions.values())
+                        and any(drafts[i] for i in positions))
         # requeue in submission order: appendleft of the newest request
         # first leaves the oldest at the queue front (slot-index order
         # would let a later request resume before an earlier one)
         preempted = eng.prepare_decode(
-            positions, n_new=eng.spec_k + 1 if spec else 1)
+            positions, n_new=k1 if spec else 1)
         for i in sorted(preempted,
                         key=lambda j: self._slots[j].request_id,
                         reverse=True):
@@ -824,8 +1044,9 @@ class ContinuousBatchingScheduler:
         if not occupied:
             return
         if spec:
-            self._spec_tick(drafts)
+            self._spec_tick(drafts, k1)
             return
+        self.stats.plain_ticks += 1
         tokens = jnp.asarray(
             [s.generated[-1] if s else 0 for s in self._slots],
             jnp.int32)
@@ -869,22 +1090,24 @@ class ContinuousBatchingScheduler:
                 reverse=True):
             self._quarantine(i, err)
 
-    def _spec_tick(self, drafts: List[List[int]]) -> None:
-        """Draft → verify → accept: one verify step over k+1 candidate
-        positions per slot, then a host walk that commits the longest
-        prefix of grid samples reproducing the drafts plus the first
-        non-matching sample (1..k+1 tokens per slot). Grid position j
-        samples with ``fold_in(seed, n_generated + j)`` — the PLAIN
-        stream's key for that token — so the committed stream is
-        bit-identical to non-speculative decode (see
-        ``serving.sampling``); acceptance only compresses ticks."""
+    def _spec_tick(self, drafts: List[List[int]], k1: int) -> None:
+        """Draft → verify → accept: one verify step over ``k1``
+        candidate positions per slot (``spec_k + 1`` for fixed engines;
+        adaptive ones narrow to the widest draft proposed), then a host
+        walk that commits the longest prefix of grid samples
+        reproducing the drafts plus the first non-matching sample
+        (1..k1 tokens per slot). Grid position j samples with
+        ``fold_in(seed, n_generated + j)`` — the PLAIN stream's key for
+        that token — so the committed stream is bit-identical to
+        non-speculative decode (see ``serving.sampling``); acceptance
+        only compresses ticks."""
         eng = self.engine
-        k1 = eng.spec_k + 1
+        self.stats.spec_ticks += 1
         rows = []
         for i, s in enumerate(self._slots):
-            d = drafts[i][:eng.spec_k]
+            d = drafts[i][:k1 - 1]
             rows.append(([s.generated[-1] if s else 0] + d
-                         + [0] * (eng.spec_k - len(d))))
+                         + [0] * (k1 - 1 - len(d))))
         tokens = jnp.asarray(rows, jnp.int32)
         temps = jnp.asarray(
             [s.request.temperature if s else 0.0 for s in self._slots],
@@ -943,6 +1166,9 @@ class ContinuousBatchingScheduler:
             counts[i] = committed
             self.stats.tokens_drafted += len(draft)
             self.stats.tokens_accepted += accepted
+            if eng.adaptive_spec and draft:
+                self._accept_ewma[i] = 0.5 * self._accept_ewma[i] \
+                    + 0.5 * accepted / len(draft)
         eng.commit(counts)
         # a tick that commits m tokens counts m toward deadlines: the
         # scheduler clock stays in decode-step equivalents across modes
@@ -960,6 +1186,172 @@ class ContinuousBatchingScheduler:
                 key=lambda t: self._slots[t[0]].request_id,
                 reverse=True):
             self._quarantine(i, err)
+
+    def _tree_tick(self, positions: Dict[int, int]) -> bool:
+        """Tree-speculative tick: pack every slot's FORCED chain (the
+        committed tokens past its cache length — at least the pending
+        token) plus its draft tree into one tree-attention verify grid,
+        sample every node with the plain stream's key for its depth,
+        and commit along the accepted root-to-leaf path
+        (:func:`~apex_tpu.serving.sampling.tree_speculative_accept`).
+        Cache lengths only advance by the row-CONTIGUOUS committed
+        prefix: tokens a path stranded off the leftmost chain are
+        re-sent as next tick's forced chain (the forced-prefix rule —
+        bounded by the tree depth, never compounding; see
+        ``serving.decode``). Returns False — tick not taken — when
+        every forced chain is trivial and no draft survived, so the
+        caller runs the plain path instead."""
+        eng = self.engine
+        ks = self._spec_ks(positions)
+        trees = self._draft_trees(ks)
+        forced: Dict[int, List[int]] = {}
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                h = list(s.request.prompt) + list(s.generated)
+                forced[i] = h[s.pos:]        # f >= 1: the pending token
+        if all(len(f) == 1 for f in forced.values()) \
+                and not any(trees[i] is not None for i in positions):
+            return False
+        # grid width: the widest forced-chain + tree, clamped to the
+        # scarcest slot's cache headroom (a slot whose chain overflows
+        # the clamped grid catches up across ticks, committing rows
+        # but sampling nothing until its chain fits)
+        avail = min(eng.max_len - pos for pos in positions.values())
+        k1 = max(len(forced[i])
+                 + (len(trees[i][0]) if trees[i] is not None else 0)
+                 for i in positions)
+        k1 = max(1, min(k1, avail))
+        preempted = eng.prepare_decode(positions, n_new=k1)
+        for i in sorted(preempted,
+                        key=lambda j: self._slots[j].request_id,
+                        reverse=True):
+            s = self._slots[i]
+            self._queue.appendleft((s.request_id, s.request,
+                                    list(s.generated)))
+            self._slots[i] = None
+            forced.pop(i, None)
+        if not forced:
+            return True
+        f_chain: List[List[int]] = []
+        g_trees: List[Optional[Tuple[List[int], List[int]]]] = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                f_chain.append([0])
+                g_trees.append(None)
+                continue
+            chain = forced[i][:k1]
+            room = k1 - len(chain)
+            tree = trees[i]
+            if tree is not None and len(chain) == len(forced[i]) \
+                    and room > 0:
+                # truncating a topological tree keeps parent validity
+                toks = [int(t) for t in tree[0][:room]]
+                pars = [int(p) for p in tree[1][:room]]
+                g_trees.append((toks, pars) if toks else None)
+            else:
+                g_trees.append(None)
+            f_chain.append(chain)
+        tok_np, dep_np, anc_np, val_np, par_np, start_np = tree_arrays(
+            f_chain, g_trees, k1)
+        temps = jnp.asarray(
+            [s.request.temperature if s else 0.0 for s in self._slots],
+            jnp.float32)
+        base = jnp.stack(
+            [jax.random.PRNGKey(s.request.seed) if s
+             else jax.random.PRNGKey(0) for s in self._slots])
+        # column j samples the (n_generated - f + 1 + depth[j])-th
+        # generated token — exactly the plain stream's key offset for
+        # that position (forced columns before the walk root land on
+        # already-committed offsets; their samples are never read)
+        offs = np.zeros((eng.num_slots, k1), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                offs[i] = (len(s.generated) - len(f_chain[i]) + 1
+                           + dep_np[i])
+        keys = self._fold_grid(base, jnp.asarray(offs))
+        logits = eng.tree_verify(jnp.asarray(tok_np),
+                                 jnp.asarray(dep_np),
+                                 jnp.asarray(anc_np))
+        finite = np.asarray(eng.finite(logits))            # (B, k1)
+        grid = np.asarray(eng.sample_grid(logits, keys, temps))
+        cnts, path = self._tree_accept(
+            jnp.asarray(grid), jnp.asarray(tok_np), jnp.asarray(par_np),
+            jnp.asarray(val_np), jnp.asarray(start_np))
+        cnts, path = np.asarray(cnts), np.asarray(path)
+        vocab = eng.cfg.vocab_size
+        counts = [0] * eng.num_slots          # cache ROWS to commit
+        new_tok_max = 0
+        quarantined: List[Tuple[int, NonFiniteLogits]] = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            f = len(f_chain[i])
+            if f < len(forced[i]):
+                # catch-up-only: the truncated chain's rows commit,
+                # nothing is sampled for this slot this tick
+                counts[i] = f
+                slot.pos += f
+                continue
+            nodes = len(g_trees[i][0]) if g_trees[i] is not None else 0
+            committed = accepted = g = 0
+            bad = None
+            for v in range(int(cnts[i])):
+                col = int(path[i, v])
+                # the always-on production gates run per VISITED node
+                # only — unvisited grid columns condition on rejected
+                # branches a plain tick would never have computed
+                if not bool(finite[i, col]):
+                    self.stats.nan_events += 1
+                    bad = NonFiniteLogits(
+                        f"slot {i} (request {slot.request_id}): "
+                        "non-finite tree-verify logits")
+                    break
+                tok = int(grid[i, col])
+                if not 0 <= tok < vocab:
+                    self.stats.bad_samples += 1
+                    bad = NonFiniteLogits(
+                        f"slot {i} (request {slot.request_id}): "
+                        f"sampled token {tok} outside [0, {vocab})")
+                    break
+                slot.generated.append(tok)
+                self._tokens_emitted += 1
+                committed += 1
+                if v:
+                    accepted += 1
+                    if g == v - 1 and col == f - 1 + v:
+                        g += 1    # the walk stayed on the leftmost chain
+                if tok == self.eos_id or len(slot.generated) \
+                        >= slot.request.max_new_tokens:
+                    break
+            # rows: the forced chain plus the contiguous accepted run
+            # (the final committed sample never has a row — it is the
+            # next pending token, exactly as in the linear walk)
+            counts[i] = f + g
+            slot.pos += f + g
+            new_tok_max = max(new_tok_max, committed)
+            self.stats.tokens_drafted += nodes
+            self.stats.tokens_accepted += accepted
+            if eng.adaptive_spec and nodes:
+                self._accept_ewma[i] = 0.5 * self._accept_ewma[i] \
+                    + 0.5 * accepted / nodes
+            if bad is not None:
+                quarantined.append((i, bad))
+        eng.commit(counts)
+        self.stats.spec_ticks += 1
+        # a tick that commits m tokens counts m toward deadlines: the
+        # scheduler clock stays in decode-step equivalents across modes
+        if new_tok_max > 1:
+            self._tick_no += new_tok_max - 1
+        qset = {i for i, _ in quarantined}
+        for i, slot in enumerate(self._slots):
+            if slot is not None and i not in qset and counts[i]:
+                self._maybe_evict(i)
+        for i, err in sorted(
+                quarantined,
+                key=lambda t: self._slots[t[0]].request_id,
+                reverse=True):
+            self._quarantine(i, err)
+        return True
 
     # -- drive loop --------------------------------------------------------
 

@@ -130,21 +130,27 @@ def fused_apply_rotary_pos_emb_bhsd(t: jax.Array, freqs: jax.Array,
                                     ) -> jax.Array:
     """(b, h, s, d) layout wrapper — the in-tree models' attention layout.
 
-    ``positions`` (optional, (b,) integer array, traced is fine) selects
-    each batch row's ABSOLUTE rotation angles from the ``freqs`` table:
-    row ``i`` of ``t`` is rotated as if its ``s`` query positions were
-    ``positions[i], positions[i]+1, ...``. This is the incremental-decode
-    entry point: a single-token query (s=1) at cache offset ``p`` must be
-    rotated by θ_p, not θ_0, and the offset differs per batch slot. The
-    default (``positions=None``) keeps the training convention — angles
-    are rows ``0..s-1`` of the table, shared across the batch."""
+    ``positions`` (optional, traced is fine) selects ABSOLUTE rotation
+    angles from the ``freqs`` table. A (b,) integer array rotates row
+    ``i`` of ``t`` as if its ``s`` query positions were
+    ``positions[i], positions[i]+1, ...`` — the incremental-decode
+    entry point: a single-token query (s=1) at cache offset ``p`` must
+    be rotated by θ_p, not θ_0, and the offset differs per batch slot.
+    A (b, s) integer array gives every element its own position — the
+    tree-verify entry point, where node j's angle is ``pos +
+    depth[j]`` and depths are NOT consecutive. The default
+    (``positions=None``) keeps the training convention — angles are
+    rows ``0..s-1`` of the table, shared across the batch."""
     cos = jnp.cos(freqs).reshape(freqs.shape[0], freqs.shape[-1])
     sin = jnp.sin(freqs).reshape(freqs.shape[0], freqs.shape[-1])
     if positions is None:
         return _rope_core(t, cos[None, None], sin[None, None])
     # (b, s) absolute positions -> gathered (b, 1, s, d) angle factors
     # broadcasting over the head axis of t (b, h, s, d)
-    idx = positions[:, None] + jnp.arange(t.shape[2])[None, :]
+    if positions.ndim == 2:
+        idx = positions
+    else:
+        idx = positions[:, None] + jnp.arange(t.shape[2])[None, :]
     return _rope_core(t, cos[idx][:, None], sin[idx][:, None])
 
 

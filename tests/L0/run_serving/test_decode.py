@@ -456,6 +456,187 @@ def test_tp_verify_matches_unsharded():
                                   np.asarray(jnp.argmax(want[0], -1)))
 
 
+# -- tree verify ------------------------------------------------------------
+#
+# One forward over a DRAFT TREE: grid node j writes its K/V at physical
+# row pos + j but attends at logical position pos + depth[j], seeing
+# committed history plus exactly its ancestor set (anc[:, j]). A linear
+# chain is the k1-wide special case and must reproduce the existing
+# verify step bit-for-bit; branch nodes must each match the full
+# forward over their OWN root-to-leaf path.
+
+def _chain_tree(k1):
+    """depth = arange, anc[src, q] = src <= q: the linear chain
+    (``anc[i, j]`` means column i visible to QUERY column j, so the
+    chain is upper-triangular in (src, query) order)."""
+    depth = jnp.arange(k1, dtype=jnp.int32)[None, :]
+    anc = jnp.triu(jnp.ones((k1, k1), bool))[None]
+    return depth, anc
+
+
+def test_tree_verify_linear_chain_bit_identical_to_verify():
+    """With a chain ancestor matrix the tree verify IS the linear
+    verify — same program shape, same writes, bit-identical logits
+    and cache. Tolerance would hide a mask bug."""
+    from apex_tpu.serving import make_tree_verify_fn, make_verify_fn
+
+    k = 3
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + k + 1)
+    prefill = make_prefill_fn(cfg)
+    cache = init_cache(cfg, 2, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    clone = jax.tree.map(jnp.copy, cache)
+    tokens = jnp.concatenate(
+        [seq[:, PROMPT:], jnp.zeros((1, k + 1), jnp.int32)], axis=0)
+    cache_a, want = make_verify_fn(cfg)(params, cache, tokens)
+    depth, anc = _chain_tree(k + 1)
+    depth = jnp.broadcast_to(depth, (2, k + 1))
+    anc = jnp.broadcast_to(anc, (2, k + 1, k + 1))
+    cache_b, got = make_tree_verify_fn(cfg)(params, clone, tokens,
+                                            depth, anc)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for a, b in zip(cache_a, cache_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tree_verify_branches_match_full_forward():
+    """A two-branch tree: root R with children A and B, A with child C.
+    Each node's logits row must equal the full forward over prompt +
+    its OWN ancestor path — sibling branches never contaminate each
+    other even though their K/V rows coexist in the window."""
+    from apex_tpu.serving import make_tree_verify_fn, tree_arrays
+
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + 1)
+    prefill = make_prefill_fn(cfg)
+    cache = init_cache(cfg, 1, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    root = int(seq[0, PROMPT])
+    a_tok, b_tok, c_tok = 101, 202, 303
+    toks, depth, anc, valid, parents, start = tree_arrays(
+        [[root]], [([a_tok, b_tok, c_tok], [-1, -1, 0])], k1=4)
+    assert list(parents[0]) == [-1, 0, 0, 1]
+    _, logits = make_tree_verify_fn(cfg)(
+        params, cache, jnp.asarray(toks), jnp.asarray(depth),
+        jnp.asarray(anc))
+    logits = np.asarray(logits[0])
+    # column j of the grid == last row of the full forward over the
+    # prompt + j's root-to-node path
+    paths = {0: [root], 1: [root, a_tok], 2: [root, b_tok],
+             3: [root, a_tok, c_tok]}
+    for col, path in paths.items():
+        full = jnp.concatenate(
+            [seq[:, :PROMPT], jnp.asarray([path], jnp.int32)], axis=1)
+        want = np.asarray(_full_logits(params, cfg, full)[0, -1])
+        np.testing.assert_allclose(logits[col], want,
+                                   rtol=1e-4, atol=1e-4)
+    # and the sibling branches really did diverge
+    assert (np.argmax(logits[1]) != np.argmax(logits[2])
+            or not np.allclose(logits[1], logits[2]))
+
+
+def test_paged_tree_verify_matches_dense():
+    """The tree mask composes with the page indirection: paged tree
+    verify agrees with the dense tree verify to tight fp32 tolerance
+    (differently shaped reductions — argmax must agree exactly)."""
+    from apex_tpu.serving import (
+        PagedDecodeEngine, make_tree_verify_fn,
+    )
+
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + 1)
+    root = int(seq[0, PROMPT])
+    from apex_tpu.serving import tree_arrays
+    toks, depth, anc, _, _, _ = tree_arrays(
+        [[root]], [([101, 202, 303], [-1, -1, 0])], k1=4)
+
+    prefill = make_prefill_fn(cfg)
+    cache = init_cache(cfg, 1, S_MAX, jnp.float32)
+    cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                       jnp.ones((PROMPT,), jnp.int32), jnp.int32(0))
+    _, want = make_tree_verify_fn(cfg)(
+        params, cache, jnp.asarray(toks), jnp.asarray(depth),
+        jnp.asarray(anc))
+
+    eng = PagedDecodeEngine(params, cfg, num_slots=1, max_len=S_MAX,
+                            num_pages=14, page_size=8,
+                            cache_dtype=jnp.float32, buckets=(8, 16, 32),
+                            spec_k=3, tree_spec=True)
+    eng.prefill(0, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+    eng.prepare_decode({0: PROMPT}, n_new=4)
+    got = eng.tree_verify(jnp.asarray(toks), jnp.asarray(depth),
+                          jnp.asarray(anc))
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(got[0], -1)),
+        np.asarray(jnp.argmax(want[0], -1)))
+
+
+def test_tp_tree_verify_matches_unsharded():
+    """tp=2 tree verify (dense + paged): the tree descriptors are
+    replicated host decisions, heads shard over ``model`` — logits
+    match the unsharded tree verify to fp32 tolerance with exact
+    argmax agreement, mirroring test_tp_verify_matches_unsharded."""
+    from apex_tpu.models.gpt import GPTModel
+    from apex_tpu.serving import (
+        PagedDecodeEngine, make_tp_paged_tree_verify_fn,
+        make_tp_tree_verify_fn, make_tree_verify_fn, tree_arrays,
+    )
+    from apex_tpu.transformer import parallel_state as ps
+
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    seq = _seq(cfg, PROMPT + 1)
+    root = int(seq[0, PROMPT])
+    toks, depth, anc, _, _, _ = tree_arrays(
+        [[root], [root]], [([101, 202, 303], [-1, -1, 0]),
+                           ([11, 22, 33], [-1, 0, 1])], k1=4)
+    toks, depth, anc = (jnp.asarray(toks), jnp.asarray(depth),
+                        jnp.asarray(anc))
+    ps.initialize_model_parallel(tensor_model_parallel_size_=2)
+    model = GPTModel(cfg, tp_size=2)
+
+    prefill = make_prefill_fn(cfg)
+    cache = init_cache(cfg, 2, S_MAX, jnp.float32)
+    for slot in (0, 1):
+        cache, _ = prefill(params, cache, seq[:, :PROMPT],
+                           jnp.ones((PROMPT,), jnp.int32),
+                           jnp.int32(slot))
+    clone = jax.tree.map(jnp.copy, cache)
+    _, want = make_tree_verify_fn(cfg)(params, cache, toks, depth, anc)
+    _, got = make_tp_tree_verify_fn(model)(params, clone, toks, depth,
+                                           anc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got, -1)),
+                                  np.asarray(jnp.argmax(want, -1)))
+
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=S_MAX,
+                            num_pages=14, page_size=8,
+                            cache_dtype=jnp.float32, buckets=(8, 16, 32),
+                            spec_k=3, tree_spec=True)
+    for slot in (0, 1):
+        eng.prefill(slot, [int(t) for t in np.asarray(seq[0, :PROMPT])])
+    eng.prepare_decode({0: PROMPT, 1: PROMPT}, n_new=4)
+    clone = jax.tree.map(jnp.copy, eng.cache)
+    want = eng.tree_verify(toks, depth, anc)
+    _, got = make_tp_paged_tree_verify_fn(model)(params, clone, toks,
+                                                 depth, anc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(jnp.argmax(got, -1)),
+                                  np.asarray(jnp.argmax(want, -1)))
+
+
 def test_init_paged_cache_validates():
     from apex_tpu.serving import init_paged_cache
     from apex_tpu.serving.cache import (

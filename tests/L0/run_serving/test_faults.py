@@ -241,6 +241,69 @@ def test_draft_fault_degrades_to_plain_and_recovers(model):
     assert sched.stats.tokens_drafted > 0
 
 
+def _model_spec_engine(model, injector=None, spec_k=3, tree=False,
+                       num_pages=20):
+    from apex_tpu.serving import DraftModel
+
+    cfg, params = model
+    dm = DraftModel(params, cfg, num_slots=2, max_len=MAX_LEN)
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                             num_pages=num_pages, page_size=4,
+                             buckets=(16, 32), spec_k=spec_k,
+                             draft_model=dm, tree_spec=tree,
+                             injector=injector)
+
+
+def test_model_draft_fault_ladder_degrades_and_recovers(model):
+    """The draft_exec LADDER on a model-drafting engine: one fired draw
+    mid-stream degrades that tick from model drafts to n-gram drafts
+    (one draft_fault, no retry charged); a consecutive fired pair kills
+    the tick's drafting entirely (plain tick, two draft_faults). Both
+    degradations recover bit-identical to the fault-free golden — the
+    draft cache's resync-by-common-prefix absorbs the skipped ticks."""
+    reqs = [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=6),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=6,
+                    temperature=0.8, seed=3)]
+
+    def run(injector=None):
+        return _drive(_model_spec_engine(model, injector), reqs,
+                      audit=True)
+
+    _, golden = run()
+    assert golden == _golden(model, reqs)  # model spec == plain decode
+    # rung 1: model draft -> n-gram draft for the tick
+    sched, outs = run(FaultInjector(schedule={"draft_exec": (1,)}))
+    assert outs == golden
+    assert sched.stats.draft_faults == 1
+    assert sched.stats.retries == 0
+    # rung 2: n-gram fails too -> plain tick, still golden
+    sched, outs = run(FaultInjector(schedule={"draft_exec": (1, 2)}))
+    assert outs == golden
+    assert sched.stats.draft_faults == 2
+    assert sched.stats.retries == 0
+    assert all(o.ok for o in sched.outcomes.values())
+
+
+def test_tree_spec_fault_ladder_recovers(model):
+    """Same ladder under TREE speculation: a degraded tick loses its
+    draft trees (n-gram chains or a plain tick) but the committed
+    streams stay bit-identical to the fault-free tree golden."""
+    reqs = [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=6),
+            Request(prompt=(5, 3, 5, 3), max_new_tokens=6,
+                    temperature=0.8, seed=3)]
+
+    def run(injector=None):
+        return _drive(_model_spec_engine(model, injector, tree=True),
+                      reqs, audit=True)
+
+    _, golden = run()
+    assert golden == _golden(model, reqs)
+    sched, outs = run(FaultInjector(schedule={"draft_exec": (1, 2)}))
+    assert outs == golden
+    assert sched.stats.draft_faults == 2
+    assert sched.stats.retries == 0
+
+
 @pytest.mark.parametrize("seed", [0, 1])
 def test_spec_multi_fault_chaos_is_typed_prefixed_and_replayable(
         model, seed):

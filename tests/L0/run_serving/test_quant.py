@@ -217,8 +217,9 @@ def test_spec_stream_w8_bit_identical_to_plain(paged):
     (spec_k=0) quantized streams — greedy AND seeded sampling. Exact
     integer equality; the accept walk compares the SAME quantized
     logits on both sides, so tolerance would hide a real rollback
-    bug. (An int8 CACHE is excluded by design: verify re-quantizes
-    rejected rows' pages, which is a documented numerics difference.)"""
+    bug. (The int8 CACHE gets the same contract separately —
+    test_kv8_rejected_tails_do_not_perturb — via the insert-then-zero
+    page requantization rule.)"""
     cfg = _cfg(True)
     qp = quantize_params(init_gpt(jax.random.PRNGKey(0), cfg))
     reqs = [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=6),
@@ -243,6 +244,49 @@ def test_spec_stream_w8_bit_identical_to_plain(paged):
     spec, stats = run(2)
     assert spec == plain
     assert stats.tokens_drafted > 0
+
+
+def test_kv8_rejected_tails_do_not_perturb():
+    """The int8-cache analogue of
+    test_decode.py::test_verify_rejected_rows_not_observable, and the
+    contract that makes kv8 speculation exact: two runs whose first
+    verify step carried DIFFERENT garbage draft tails must produce
+    bit-identical later verify AND plain-decode logits. The verify
+    write pins the page scale for tail columns (rescale only at the
+    window root) and zeroes rows strictly after each insert, so a
+    rejected tail can never re-round committed history. The prompt is
+    deliberately NOT page-aligned (6 tokens, page_size 4): the verify
+    window straddles a half-full page, the case where a naive
+    requantize would perturb committed rows."""
+    k = 3
+    cfg = _cfg(True)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    pl = 6  # mid-page: rows 4..5 of page 1 committed, tails land 6..9
+    seq = _seq(cfg)
+
+    def run(garbage):
+        eng = PagedDecodeEngine(params, cfg, num_slots=1, max_len=S_MAX,
+                                num_pages=14, page_size=4,
+                                cache_dtype=jnp.int8, buckets=(8, 16),
+                                spec_k=k)
+        eng.prefill(0, [int(t) for t in np.asarray(seq[0, :pl])])
+        eng.prepare_decode({0: pl}, n_new=k + 1)
+        bad = jnp.concatenate(
+            [seq[:, pl:pl + 1], jnp.full((1, k), garbage, jnp.int32)],
+            axis=1)
+        eng.verify(bad)
+        eng.commit([1])  # only the pending token survives the walk
+        eng.prepare_decode({0: pl + 1}, n_new=k + 1)
+        l_verify = eng.verify(seq[:, pl + 1:pl + k + 2])
+        eng.commit([1])
+        eng.prepare_decode({0: pl + 2})
+        l_plain = eng.decode(seq[:, pl + 2], jnp.asarray([True]))
+        return np.asarray(l_verify), np.asarray(l_plain)
+
+    va, pa = run(3)
+    vb, pb = run(499)
+    np.testing.assert_array_equal(va, vb)
+    np.testing.assert_array_equal(pa, pb)
 
 
 # -- int8 KV edge cases -----------------------------------------------------

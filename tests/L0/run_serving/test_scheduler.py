@@ -399,3 +399,169 @@ def test_paged_submit_validates_page_demand():
     sched.submit(Request(prompt=(2, 3, 5, 7, 11), max_new_tokens=7))
     outs = sched.run()
     assert len(outs) == 1 and 1 <= len(outs[0]) <= 7
+
+
+# -- model-based & tree speculation -----------------------------------------
+#
+# Same contract as linear n-gram spec, new machinery: a TP-shardable
+# draft GPT proposes the candidates (DraftModel), optionally as trees
+# verified in one forward through the ancestor-matrix mask, with a
+# per-stream adaptive depth controller. Every mode must keep committed
+# streams integer-identical to plain spec_k=0 decode.
+
+def _draft_for(params, cfg, num_slots):
+    # the TARGET doubles as its own drafter: acceptance is high, so the
+    # accept walk, the tree path commit, and the draft-cache resync all
+    # run on real accept/reject mixes instead of the all-rejected path
+    from apex_tpu.serving import DraftModel
+    return DraftModel(params, cfg, num_slots=num_slots, max_len=MAX_LEN)
+
+
+def _model_spec_run(params, cfg, requests, num_slots, spec_k, paged,
+                    tree=False, adaptive=False, self_draft=True):
+    if self_draft:
+        dm = _draft_for(params, cfg, num_slots) if spec_k else None
+    else:  # a genuinely different (randomly-initialised) draft net
+        dm = (None if not spec_k else
+              _draft_for(init_gpt(jax.random.PRNGKey(99), cfg), cfg,
+                         num_slots))
+    kw = dict(spec_k=spec_k, draft_model=dm, tree_spec=tree,
+              adaptive_spec=adaptive)
+    if not spec_k:
+        kw = {}
+    if paged:
+        engine = PagedDecodeEngine(params, cfg, num_slots=num_slots,
+                                   max_len=MAX_LEN, num_pages=24,
+                                   page_size=4, buckets=(16, 32), **kw)
+    else:
+        engine = DecodeEngine(params, cfg, num_slots=num_slots,
+                              max_len=MAX_LEN, buckets=(16, 32), **kw)
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS, audit=paged)
+    for r in requests:
+        sched.submit(r)
+    return sched.run(), sched.stats
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_model_draft_stream_bit_identical_to_plain(paged):
+    """Model-drafted linear speculation (greedy + seeded sampled): the
+    committed streams equal the plain run token-for-token, and the
+    self-draft actually lands accepts (the resync path is exercised on
+    both full and partial acceptance)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _spec_requests()
+    plain, _ = _model_spec_run(params, cfg, reqs, 2, 0, paged)
+    spec, stats = _model_spec_run(params, cfg, reqs, 2, 3, paged)
+    assert spec == plain
+    assert stats.tokens_drafted > 0
+    assert stats.tokens_accepted > 0  # self-draft must make progress
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_tree_spec_stream_bit_identical_to_plain(paged):
+    """Tree speculation: multi-branch drafts verified in ONE forward
+    via the ancestor mask, the accept walk following the committed
+    root-to-leaf path. Streams stay integer-identical to plain decode
+    on both layouts, and the tree path commits accepted tokens."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _spec_requests()
+    plain, _ = _model_spec_run(params, cfg, reqs, 2, 0, paged)
+    spec, stats = _model_spec_run(params, cfg, reqs, 2, 3, paged,
+                                  tree=True)
+    assert spec == plain
+    assert stats.spec_ticks > 0
+    assert stats.tokens_accepted > 0
+
+
+def test_tree_spec_with_mismatched_draft_still_exact():
+    """A randomly-initialised draft net proposes mostly-wrong trees —
+    the rejected tails and the forced-chain re-sends must still leave
+    the committed stream exactly equal to plain decode (the rollback /
+    resync contract under worst-case rejection)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _spec_requests()
+    plain, _ = _model_spec_run(params, cfg, reqs, 2, 0, False)
+    spec, _ = _model_spec_run(params, cfg, reqs, 2, 3, False,
+                              tree=True, self_draft=False)
+    assert spec == plain
+
+
+def test_ngram_tree_spec_matches_plain():
+    """tree_spec without a draft model: n-gram chains ride the tree
+    verify path as single-branch trees. Still exact."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _spec_requests()
+    engine = DecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                          buckets=(16, 32), spec_k=3, tree_spec=True)
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS)
+    for r in reqs:
+        sched.submit(r)
+    plain, _ = _model_spec_run(params, cfg, reqs, 2, 0, False)
+    assert sched.run() == plain
+
+
+def test_adaptive_controller_converges_to_plain():
+    """On an adversarial stream (high-temperature sampling against a
+    mismatched draft net) the per-stream EWMA controller must shrink
+    spec_k to plain ticks: the run stays integer-identical to plain
+    decode, most ticks are plain, and the tick count never exceeds the
+    plain run's (each tick commits >= 1 token, so adaptive spec can
+    only match or beat plain pace — the same-process A/B contract)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(3, 1, 4, 1, 5), max_new_tokens=20,
+                    temperature=5.0, seed=123),
+            Request(prompt=(2, 7, 1, 8), max_new_tokens=20,
+                    temperature=4.0, seed=77)]
+    plain, pstats = _model_spec_run(params, cfg, reqs, 2, 0, False)
+    out, stats = _model_spec_run(params, cfg, reqs, 2, 4, False,
+                                 adaptive=True, self_draft=False)
+    assert out == plain
+    assert stats.plain_ticks > stats.spec_ticks  # converged toward plain
+    assert (stats.plain_ticks + stats.spec_ticks
+            <= pstats.plain_ticks)  # never slower than plain (in ticks)
+
+
+def test_adaptive_controller_keeps_speculating_when_accepted():
+    """The flip side: with the target as its own drafter, acceptance
+    stays high and the controller must KEEP the depth up (mostly spec
+    ticks), finishing in fewer ticks than plain decode."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(7, 11, 7, 11, 7), max_new_tokens=12),
+            Request(prompt=(13, 17, 19), max_new_tokens=12)]
+    plain, pstats = _model_spec_run(params, cfg, reqs, 2, 0, False)
+    out, stats = _model_spec_run(params, cfg, reqs, 2, 3, False,
+                                 adaptive=True)
+    assert out == plain
+    assert stats.spec_ticks > 0
+    assert (stats.plain_ticks + stats.spec_ticks
+            < pstats.plain_ticks)  # strictly fewer parameter reads
+
+
+def test_spec_config_validation():
+    """draft_model / tree_spec / adaptive_spec all require spec_k >= 1;
+    the draft net must match the target's slot count and vocab; tree
+    verify refuses the int8 page pool."""
+    import jax.numpy as jnp
+    cfg = _cfg()
+    params = _params(cfg)
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                     tree_spec=True)
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                     adaptive_spec=True)
+    with pytest.raises(ValueError, match="slots"):
+        DecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                     spec_k=2, draft_model=_draft_for(params, cfg, 1))
+    with pytest.raises(ValueError, match="int8"):
+        PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                          num_pages=24, page_size=4, spec_k=2,
+                          tree_spec=True, cache_dtype=jnp.int8)
