@@ -32,14 +32,16 @@ name`` statements), maps each dotted module back to its file in the
 linted set, and seeds them into that file's reachability frontier.
 
 Beyond the stdlib host modules, apex_tpu's OWN host state is
-registered: ``serving.faults`` (fault schedules, call counters) and
-``serving.health`` (``ServingStats`` degradation counters) exist to be
-mutated between ticks, so reading them inside a traced body freezes a
-counter value into the compiled program — the canonical staleness bug
-this tier exists for. Any use of those modules' stateful classes — or
-of a module-level instance constructed from them — inside a reachable
-function is APX401 (see ``_HOST_STATE_MODULES``/``_HOST_STATE_SYMBOLS``
-and the ``apx401_hoststate_*`` fixtures).
+registered: ``serving.faults`` (fault schedules, call counters),
+``serving.health`` (``ServingStats`` degradation counters), and
+``serving.observe`` (tracer flags, metric registries, flight-recorder
+rings) exist to be mutated between ticks, so reading them inside a
+traced body freezes a counter value into the compiled program — the
+canonical staleness bug this tier exists for. Any use of those
+modules' stateful classes — or of a module-level instance constructed
+from them — inside a reachable function is APX401 (see
+``_HOST_STATE_MODULES``/``_HOST_STATE_SYMBOLS`` and the
+``apx401_hoststate_*`` / ``apx401_observe_*`` fixtures).
 """
 
 import ast
@@ -62,10 +64,12 @@ _DECORATOR_ROOTS = {"custom_vjp", "custom_jvp", "jit", "checkpoint",
 #: counters/schedules mutate between scheduler ticks, so a traced body
 #: reading them bakes one stale value into the compiled program.
 _HOST_STATE_MODULES = {"apex_tpu.serving.faults",
-                       "apex_tpu.serving.health"}
+                       "apex_tpu.serving.health",
+                       "apex_tpu.serving.observe"}
 #: The stateful classes those modules export (re-exported by
 #: ``apex_tpu.serving``); instances are mutated on the host every tick.
-_HOST_STATE_SYMBOLS = {"FaultInjector", "ServingStats"}
+_HOST_STATE_SYMBOLS = {"FaultInjector", "ServingStats", "Tracer",
+                       "MetricsRegistry", "FlightRecorder"}
 
 
 def _host_modules(tree: ast.Module) -> Dict[str, str]:

@@ -38,7 +38,13 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
 - ``faults``    — deterministic fault injection: a seedable
   ``FaultInjector`` consulted at named host-side sites, schedules a
   pure function of (seed, site, call index) so chaos runs replay
-  bit-for-bit (``tests/L0/run_serving/test_faults.py``).
+  bit-for-bit (``tests/L0/run_serving/test_faults.py``);
+- ``observe``   — host-side observability hooked the same way: a
+  span/event ``Tracer`` on the deterministic tick clock (replay-exact
+  streams, Perfetto JSONL dumps), a ``MetricsRegistry`` of counters/
+  gauges/latency histograms (``ServingStats`` is a view over it), and
+  a ``FlightRecorder`` ring that typed ``ServingError``\\ s attach to
+  their payloads.
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
@@ -63,6 +69,9 @@ from apex_tpu.serving.health import (  # noqa: F401
     FINISH_REASONS, AdmissionRejected, DeadlineExceeded, LivelockError,
     NonFiniteLogits, PoolExhausted, PoolInvariantError, RequestOutcome,
     RetryBudgetExhausted, ServingError, ServingStats,
+)
+from apex_tpu.serving.observe import (  # noqa: F401
+    FlightRecorder, MetricsRegistry, TraceEvent, Tracer,
 )
 from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
 from apex_tpu.serving.sampling import (  # noqa: F401

@@ -8,7 +8,9 @@ request can fail is a named exception the scheduler either *recovers
 from* (retry/requeue) or *reports* (a :class:`RequestOutcome` with a
 typed reason), and every degradation event increments a counter in
 :class:`ServingStats` so a chaos run — or a production dashboard — can
-see exactly how the engine bent instead of broke.
+see exactly how the engine bent instead of broke. The counters are a
+view over the ``serving.observe`` :class:`MetricsRegistry`, so the
+same numbers come out of the Prometheus/JSON exports.
 
 Everything here is plain host-side Python: no jax imports, no device
 state, no clocks. Counters and exceptions must NEVER be consulted from
@@ -46,6 +48,8 @@ Taxonomy (all subclass :class:`ServingError`):
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+from apex_tpu.serving.observe import MetricsRegistry
+
 #: ``RequestOutcome.reason`` values — the full set of ways a request
 #: terminates. Healthy: ``eos`` / ``length`` / ``cache_full``; degraded
 #: (``error`` carries the typed exception): ``retry_budget`` /
@@ -55,7 +59,15 @@ FINISH_REASONS = ("eos", "length", "cache_full", "retry_budget",
 
 
 class ServingError(RuntimeError):
-    """Base of the serving failure taxonomy."""
+    """Base of the serving failure taxonomy. Every instance carries a
+    ``payload`` dict of host-side diagnostics; when tracing is enabled
+    the scheduler attaches the flight-recorder ring under
+    ``payload["flight"]`` (``serving.observe``), so the error ships its
+    own last-N-events post-mortem."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.payload: Dict[str, Any] = {}
 
 
 class PoolExhausted(ServingError):
@@ -113,6 +125,7 @@ class LivelockError(ServingError):
         super().__init__(msg)
         self.stuck = stuck or {}
         self.pool = pool or {}
+        self.payload.update(stuck=self.stuck, pool=self.pool)
 
 
 class PoolInvariantError(ServingError):
@@ -121,27 +134,80 @@ class PoolInvariantError(ServingError):
     audit, ``PagePool.check_invariants``."""
 
 
-@dataclasses.dataclass
+#: ``ServingStats`` counter fields -> help text. Order defines the
+#: ``as_dict`` / Prometheus export order; each field is backed by a
+#: ``serving_<field>_total`` counter in the stats' MetricsRegistry.
+STAT_FIELDS = {
+    "admission_rejections": "submit() refused: queue full",
+    "pool_exhausted": "admissions parked waiting for pages",
+    "preemptions": "slots requeued on page pressure",
+    "cow_copies": "shared pages cloned before append",
+    "retries": "fault-path requeues (budgeted)",
+    "nan_events": "non-finite logits quarantines",
+    "bad_samples": "out-of-vocab sampled tokens",
+    "deadline_expired": "requests cut at deadline_ticks",
+    "evictions": "healthy completions freeing a slot",
+    "tokens_drafted": "speculative candidates proposed",
+    "tokens_accepted": "drafted candidates that committed",
+    "draft_faults": "draft_exec faults (degraded ticks)",
+    "spec_ticks": "verify-step ticks (linear or tree)",
+    "plain_ticks": "single-token decode ticks",
+}
+
+
 class ServingStats:
     """Degradation counters, shared by an engine and its scheduler.
     Pure host-side ints (never read these inside a traced function —
     APX401). ``bench.py gpt_decode`` emits the non-zero subset so the
-    driver tracks degradation behavior across rounds."""
+    driver tracks degradation behavior across rounds.
 
-    admission_rejections: int = 0  # submit() refused: queue full
-    pool_exhausted: int = 0        # admissions parked waiting for pages
-    preemptions: int = 0           # slots requeued on page pressure
-    cow_copies: int = 0            # shared pages cloned before append
-    retries: int = 0               # fault-path requeues (budgeted)
-    nan_events: int = 0            # non-finite logits quarantines
-    bad_samples: int = 0           # out-of-vocab sampled tokens
-    deadline_expired: int = 0      # requests cut at deadline_ticks
-    evictions: int = 0             # healthy completions freeing a slot
-    tokens_drafted: int = 0        # speculative candidates proposed
-    tokens_accepted: int = 0       # drafted candidates that committed
-    draft_faults: int = 0          # draft_exec faults (degraded ticks)
-    spec_ticks: int = 0            # verify-step ticks (linear or tree)
-    plain_ticks: int = 0           # single-token decode ticks
+    Since the observability PR this is a *view* over a
+    :class:`~apex_tpu.serving.observe.MetricsRegistry`: every field in
+    :data:`STAT_FIELDS` is backed by the ``serving_<field>_total``
+    counter in ``registry`` (attribute reads and ``+=`` writes go
+    straight to the counter object), so the legacy counter block and
+    the Prometheus/JSON exports share storage and cannot drift. The
+    engine passes its tracer's registry; a bare ``ServingStats()``
+    still works and owns a private registry.
+    """
+
+    FIELDS = tuple(STAT_FIELDS)
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 **counts: int):
+        unknown = set(counts) - set(STAT_FIELDS)
+        if unknown:
+            raise TypeError(f"unknown ServingStats fields: {sorted(unknown)}")
+        d = self.__dict__
+        d["registry"] = registry if registry is not None else MetricsRegistry()
+        d["_counters"] = {
+            f: d["registry"].counter(f"serving_{f}_total", help=doc)
+            for f, doc in STAT_FIELDS.items()}
+        for f, v in counts.items():
+            d["_counters"][f].value = int(v)
+
+    def __getattr__(self, name):
+        c = self.__dict__.get("_counters", {}).get(name)
+        if c is None:
+            raise AttributeError(name)
+        return c.value
+
+    def __setattr__(self, name, value):
+        c = self.__dict__.get("_counters", {}).get(name)
+        if c is None:
+            raise AttributeError(f"ServingStats has no counter {name!r}")
+        c.value = int(value)
+
+    def __eq__(self, other):
+        if not isinstance(other, ServingStats):
+            return NotImplemented
+        return ({f: c.value for f, c in self._counters.items()} ==
+                {f: c.value for f, c in other._counters.items()})
+
+    def __repr__(self):
+        inner = ", ".join(f"{f}={c.value}"
+                          for f, c in self._counters.items())
+        return f"ServingStats({inner})"
 
     @property
     def acceptance_rate(self) -> float:
@@ -153,8 +219,10 @@ class ServingStats:
             return 0.0
         return self.tokens_accepted / self.tokens_drafted
 
-    def as_dict(self) -> Dict[str, int]:
-        return dataclasses.asdict(self)
+    def as_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {f: c.value for f, c in self._counters.items()}
+        d["acceptance_rate"] = round(self.acceptance_rate, 6)
+        return d
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,12 +231,19 @@ class RequestOutcome:
     reason (one of :data:`FINISH_REASONS`). Degraded terminations carry
     the :class:`ServingError` that ended them in ``error``; for those,
     ``tokens`` is a prefix of the fault-free stream (quarantine never
-    commits a corrupt token)."""
+    commits a corrupt token).
+
+    ``ttft_ticks`` / ``total_ticks`` are tick-clock latencies stamped
+    by the scheduler's tracer bookkeeping: submit -> first committed
+    token, and submit -> termination. ``ttft_ticks`` is ``None`` when
+    the request died before emitting anything."""
 
     tokens: Tuple[int, ...]
     reason: str
     error: Optional[ServingError] = None
     retries: int = 0
+    ttft_ticks: Optional[int] = None
+    total_ticks: Optional[int] = None
 
     @property
     def ok(self) -> bool:

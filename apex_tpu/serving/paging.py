@@ -108,6 +108,19 @@ class PagePool:
     def num_cached(self) -> int:
         return len(self._prefix)
 
+    @property
+    def num_usable(self) -> int:
+        """Pages the allocator may ever hand out (total minus the
+        reserved null/scratch pages)."""
+        return self.num_pages - RESERVED_PAGES
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of usable pages currently referenced (slots or the
+        prefix registry) — the ``serving_page_pool_occupancy`` gauge
+        the tracer samples every tick."""
+        return (self.num_usable - self.num_free) / self.num_usable
+
     def refcount(self, page: int) -> int:
         return self._ref.get(page, 0)
 
@@ -262,4 +275,5 @@ class PagePool:
         (:class:`~apex_tpu.serving.health.LivelockError` payloads)."""
         return {"num_free": self.num_free,
                 "num_cached": self.num_cached,
+                "occupancy": self.occupancy,
                 "refcounts": dict(self._ref)}

@@ -126,6 +126,7 @@ from apex_tpu.serving.health import (
     PoolExhausted, RequestOutcome, RetryBudgetExhausted, ServingStats,
 )
 from apex_tpu.quant.params import is_quantized_tree
+from apex_tpu.serving.observe import Tracer
 from apex_tpu.serving.paging import PagePool, prefix_page_keys
 from apex_tpu.serving.sampling import (
     finite_rows, sample_token_grid, sample_tokens,
@@ -164,9 +165,11 @@ class DecodeEngine:
     ``top_p`` and ``spec_k`` are static — engine settings, compiled
     into the programs (``spec_k`` is the DRAFT DEPTH; 0 disables
     speculation). ``injector`` hooks the fault sites (inert by
-    default); ``stats`` is the
+    default); ``tracer`` hooks the observability sites the same way
+    (``serving.observe`` — disabled by default, one attribute check
+    per site); ``stats`` is the
     :class:`~apex_tpu.serving.health.ServingStats` counter block the
-    scheduler shares."""
+    scheduler shares, a view over the tracer's metrics registry."""
 
     paged = False
 
@@ -177,7 +180,8 @@ class DecodeEngine:
                  compute_dtype=None,
                  injector: Optional[FaultInjector] = None,
                  draft_model=None, tree_spec: bool = False,
-                 adaptive_spec: bool = False):
+                 adaptive_spec: bool = False,
+                 tracer: Optional[Tracer] = None):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -196,7 +200,8 @@ class DecodeEngine:
         self.tree_spec = tree_spec
         self.adaptive_spec = adaptive_spec
         self.injector = injector or FaultInjector()
-        self.stats = ServingStats()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.stats = ServingStats(registry=self.tracer.registry)
         if jnp.dtype(cache_dtype) == jnp.int8:
             raise ValueError(
                 "the dense cache has no int8 mode (per-page scales need "
@@ -251,8 +256,13 @@ class DecodeEngine:
                                 self.injector.calls("prefill_exec") - 1)
         ids = np.asarray(prompt, np.int32)[None, :]
         ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("prefill")
         self.cache, logits = self._prefill(
             self.params, self.cache, ids, mask, jnp.int32(slot))
+        if trc.enabled:
+            trc.end("prefill", slot=slot, bucket=int(ids.shape[1]))
         return logits
 
     def decode(self, tokens: jax.Array, active: jax.Array) -> jax.Array:
@@ -263,8 +273,13 @@ class DecodeEngine:
         rows stay bit-exact, and the scheduler's finiteness gate
         (:func:`~apex_tpu.serving.sampling.finite_rows`) must catch
         it."""
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("exec")
         self.cache, logits = self._decode(self.params, self.cache,
                                           tokens, active)
+        if trc.enabled:
+            trc.end("exec", kind="decode")
         fired, payload = self.injector.draw("decode_exec")
         if fired:
             victim = int(payload % logits.shape[0])
@@ -357,8 +372,13 @@ class DecodeEngine:
         accept walk knows each slot's count. The ``decode_exec`` fault
         site covers this step too (the victim row goes NaN across all
         positions, post-jit)."""
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("exec")
         self.cache, logits = self._verify(self.params, self.cache,
                                           tokens)
+        if trc.enabled:
+            trc.end("exec", kind="verify", k1=int(tokens.shape[1]))
         fired, payload = self.injector.draw("decode_exec")
         if fired:
             victim = int(payload % logits.shape[0])
@@ -374,8 +394,13 @@ class DecodeEngine:
         ancestor columns under ``anc``. Returns (num_slots, k1, V) fp32
         logits; commits stay host-side (:meth:`commit`). Shares the
         ``decode_exec`` fault site with the other step kinds."""
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("exec")
         self.cache, logits = self._tree_verify(self.params, self.cache,
                                                tokens, depth, anc)
+        if trc.enabled:
+            trc.end("exec", kind="tree_verify", k1=int(tokens.shape[1]))
         fired, payload = self.injector.draw("decode_exec")
         if fired:
             victim = int(payload % logits.shape[0])
@@ -387,9 +412,14 @@ class DecodeEngine:
         the host half of the verify step's rollback contract: rows
         beyond ``lengths + count`` were written but are never admitted
         by any mask before the next step re-writes them."""
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("commit")
         self.cache = self.cache._replace(
             lengths=self.cache.lengths
             + jnp.asarray(counts, jnp.int32))
+        if trc.enabled:
+            trc.end("commit", rows=int(sum(int(c) for c in counts)))
 
     def sample_grid(self, logits, keys, temperature) -> jax.Array:
         """Sample every (slot, position) of a verify step's logits with
@@ -431,6 +461,11 @@ class DecodeEngine:
         """Allocator state for diagnostics (LivelockError payloads)."""
         return {}
 
+    def pool_gauges(self) -> Optional[Dict[str, float]]:
+        """Gauge sources for the tracer's end-of-tick rollup
+        (``None``: the dense cache has no page pool to meter)."""
+        return None
+
 
 class PagedDecodeEngine(DecodeEngine):
     """:class:`DecodeEngine` over the paged cache: a fixed page pool,
@@ -460,7 +495,8 @@ class PagedDecodeEngine(DecodeEngine):
                  prefix_sharing: bool = True,
                  injector: Optional[FaultInjector] = None,
                  draft_model=None, tree_spec: bool = False,
-                 adaptive_spec: bool = False):
+                 adaptive_spec: bool = False,
+                 tracer: Optional[Tracer] = None):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -490,7 +526,8 @@ class PagedDecodeEngine(DecodeEngine):
         self.tree_spec = tree_spec
         self.adaptive_spec = adaptive_spec
         self.injector = injector or FaultInjector()
-        self.stats = ServingStats()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.stats = ServingStats(registry=self.tracer.registry)
         # both quantization levers are independent: weight-only int8 is
         # detected from the tree (dequant-fused dense/logits kernels),
         # kv_dtype=int8 from the cache (the cores branch on the scale
@@ -572,9 +609,15 @@ class PagedDecodeEngine(DecodeEngine):
         write[len(shared):n_pages] = private
         row = np.full((self.max_pages,), NULL_PAGE, np.int32)
         row[:n_pages] = pages
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("prefill")
         self.cache, logits = self._prefill(
             self.params, self.cache, ids, mask, jnp.int32(slot),
             jnp.asarray(write), jnp.asarray(row))
+        if trc.enabled:
+            trc.end("prefill", slot=slot, bucket=int(ids.shape[1]),
+                    shared_pages=len(shared))
         if self.prefix_sharing:
             self.pool.register_prefix(keys, pages)
         return logits
@@ -669,6 +712,11 @@ class PagedDecodeEngine(DecodeEngine):
         snap["slot_pages"] = [list(p) for p in self._slot_pages]
         return snap
 
+    def pool_gauges(self) -> Dict[str, float]:
+        return {"free": self.pool.num_free,
+                "cached": self.pool.num_cached,
+                "occupancy": self.pool.occupancy}
+
 
 class ContinuousBatchingScheduler:
     """FIFO → fixed slots → batched decode ticks, with the
@@ -687,12 +735,17 @@ class ContinuousBatchingScheduler:
         self.watchdog_limit = watchdog_limit
         self.audit = audit
         self.stats = engine.stats  # one counter block per engine
+        self.tracer = engine.tracer  # one tracer per engine, like stats
         self.outcomes: Dict[int, RequestOutcome] = {}
         self._queue: deque = deque()
         self._slots: List[Optional[_Slot]] = [None] * engine.num_slots
         self._next_id = 0
         self._retries: Dict[int, int] = {}
         self._submit_tick: Dict[int, int] = {}
+        # tick-clock latency bookkeeping (feeds RequestOutcome.ttft/
+        # total_ticks and, when tracing, the TTFT/ITL histograms)
+        self._first_token_tick: Dict[int, int] = {}
+        self._last_token_tick: Dict[int, int] = {}
         self._tick_no = 0
         self._tokens_emitted = 0
         # (B,) base keys × (B, k1) offsets -> (B, k1, 2) per-position
@@ -733,6 +786,10 @@ class ContinuousBatchingScheduler:
         rid = self._next_id
         self._next_id += 1
         self._submit_tick[rid] = self._tick_no
+        trc = self.tracer
+        if trc.enabled:
+            trc.instant("submitted", request_id=rid,
+                        prompt_len=len(request.prompt))
         # third element: tokens already generated — empty for fresh
         # submissions, carried through preemption/quarantine requeue
         self._queue.append((rid, request, []))
@@ -746,13 +803,44 @@ class ContinuousBatchingScheduler:
 
     def _finish(self, rid: int, tokens: Sequence[int], reason: str,
                 error=None) -> None:
+        ttft = None
+        if rid in self._first_token_tick:
+            ttft = (self._first_token_tick[rid]
+                    - self._submit_tick.get(rid, 0))
+        total = self._tick_no - self._submit_tick.get(rid, self._tick_no)
+        trc = self.tracer
+        if trc.enabled:
+            if error is not None:
+                trc.attach(error)  # ship the flight-recorder ring
+            trc.instant("finished", request_id=rid, reason=reason,
+                        ok=error is None)
         self.outcomes[rid] = RequestOutcome(
             tuple(int(t) for t in tokens), reason, error,
-            retries=self._retries.get(rid, 0))
+            retries=self._retries.get(rid, 0),
+            ttft_ticks=ttft, total_ticks=total)
+
+    def _note_token(self, rid: int, slot: int) -> None:
+        """Per-committed-token tick-clock bookkeeping. The first token
+        stamps TTFT; later ones stamp the inter-token gap (tokens
+        within one multi-token speculative commit share a tick, so
+        their gap records as 0 — honest SLO accounting)."""
+        tick = self._tick_no
+        trc = self.tracer
+        if rid not in self._first_token_tick:
+            self._first_token_tick[rid] = tick
+            if trc.enabled:
+                trc.instant("first_token", request_id=rid, slot=slot)
+                trc.observe_ttft(tick - self._submit_tick.get(rid, tick))
+        elif trc.enabled:
+            trc.observe_itl(tick - self._last_token_tick[rid])
+        self._last_token_tick[rid] = tick
 
     def _charge_retry(self, rid: int) -> bool:
         """Consume one unit of ``rid``'s retry budget; True when the
         budget is now exhausted (the caller must terminate it)."""
+        trc = self.tracer
+        if trc.enabled:
+            trc.instant("retried", request_id=rid)
         self.stats.retries += 1
         n = self._retries.get(rid, 0) + 1
         self._retries[rid] = n
@@ -770,6 +858,10 @@ class ContinuousBatchingScheduler:
         stream is bit-identical to the uncontended one) or, with the
         budget gone, terminate it typed."""
         s = self._slots[i]
+        trc = self.tracer
+        if trc.enabled:
+            trc.instant("quarantined", request_id=s.request_id, slot=i,
+                        cause=str(err))
         self._slots[i] = None
         self.engine.free_slot(i)
         rid = s.request_id
@@ -831,12 +923,15 @@ class ContinuousBatchingScheduler:
                 self.stats.pool_exhausted += 1
                 if all(s is None for s in self._slots) \
                         and not eng.injector.armed:
-                    raise PoolExhausted(
+                    err = PoolExhausted(
                         "page pool cannot admit the queue head even "
                         f"with every slot free (request {rid}) — "
                         "submit-time validation should have rejected "
                         "it", need=e.need, free=e.free,
-                        cached=e.cached) from e
+                        cached=e.cached)
+                    if self.tracer.enabled:
+                        self.tracer.attach(err)
+                    raise err from e
                 break
             except InjectedFault as e:
                 # transient exec failure; the engine rolled back its
@@ -875,10 +970,16 @@ class ContinuousBatchingScheduler:
             self._queue.popleft()
             slot = _Slot(rid, req, len(req.prompt), list(resume),
                          len(tokens))
+            trc = self.tracer
+            if trc.enabled:
+                trc.instant("admitted", request_id=rid, slot=i,
+                            resumed=bool(resume))
             if first_tok is not None:
                 slot.generated.append(first_tok)
                 self._tokens_emitted += 1
             self._slots[i] = slot
+            if first_tok is not None:
+                self._note_token(rid, i)
             self._accept_ewma[i] = 1.0
             self._maybe_evict(i)
 
@@ -996,6 +1097,7 @@ class ContinuousBatchingScheduler:
 
     def _tick(self) -> None:
         eng = self.engine
+        trc = self.tracer
         # give every occupied slot an exclusive write target for this
         # tick; slots the pool can't serve are preempted back to the
         # queue FRONT with their progress (sampling keys depend only on
@@ -1018,8 +1120,15 @@ class ContinuousBatchingScheduler:
             # verify at the compiled spec_k + 1 width; adaptive ones
             # narrow to 1 + the widest draft actually proposed, so the
             # per-tick page charge below tracks the controller.
-            drafts = self._draft_all(self._spec_ks(positions)) \
-                if eng.spec_k > 0 and positions else None
+            if eng.spec_k > 0 and positions:
+                if trc.enabled:
+                    trc.begin("draft")
+                drafts = self._draft_all(self._spec_ks(positions))
+                if trc.enabled:
+                    trc.end("draft",
+                            proposed=sum(len(d) for d in drafts))
+            else:
+                drafts = None
             k1 = eng.spec_k + 1
             if drafts is not None and eng.adaptive_spec:
                 k1 = 1 + max((len(drafts[i]) for i in positions),
@@ -1031,12 +1140,19 @@ class ContinuousBatchingScheduler:
         # requeue in submission order: appendleft of the newest request
         # first leaves the oldest at the queue front (slot-index order
         # would let a later request resume before an earlier one)
+        if trc.enabled:
+            trc.begin("prepare_decode")
         preempted = eng.prepare_decode(
             positions, n_new=k1 if spec else 1)
+        if trc.enabled:
+            trc.end("prepare_decode", preempted=len(preempted))
         for i in sorted(preempted,
                         key=lambda j: self._slots[j].request_id,
                         reverse=True):
             s = self._slots[i]
+            if trc.enabled:
+                trc.instant("preempted", request_id=s.request_id,
+                            slot=i)
             self._queue.appendleft((s.request_id, s.request,
                                     list(s.generated)))
             self._slots[i] = None
@@ -1058,8 +1174,13 @@ class ContinuousBatchingScheduler:
             [self._slot_key(s) if s else jax.random.PRNGKey(0)
              for s in self._slots])
         logits = eng.decode(tokens, active)
+        if trc.enabled:
+            trc.begin("accept")
         finite = np.asarray(eng.finite(logits))
         next_tokens = np.asarray(eng.sample(logits, keys, temps))
+        if trc.enabled:
+            trc.end("accept")
+            trc.begin("commit")
         vocab = eng.cfg.vocab_size
         quarantined: List[Tuple[int, NonFiniteLogits]] = []
         for i, slot in enumerate(self._slots):
@@ -1081,7 +1202,10 @@ class ContinuousBatchingScheduler:
             slot.generated.append(tok)
             slot.pos += 1
             self._tokens_emitted += 1
+            self._note_token(slot.request_id, i)
             self._maybe_evict(i)
+        if trc.enabled:
+            trc.end("commit")
         # quarantine AFTER the healthy slots commit, requeueing at the
         # front in submission order (same rule as preemption)
         for i, err in sorted(
@@ -1102,6 +1226,7 @@ class ContinuousBatchingScheduler:
         non-speculative decode (see ``serving.sampling``); acceptance
         only compresses ticks."""
         eng = self.engine
+        trc = self.tracer
         self.stats.spec_ticks += 1
         rows = []
         for i, s in enumerate(self._slots):
@@ -1120,6 +1245,8 @@ class ContinuousBatchingScheduler:
              for s in self._slots], jnp.int32)
         keys = self._fold_grid(base, offs)
         logits = eng.verify(tokens)
+        if trc.enabled:
+            trc.begin("accept")
         finite = np.asarray(eng.finite(logits))            # (B, k1)
         grid = np.asarray(eng.sample_grid(logits, keys, temps))
         vocab = eng.cfg.vocab_size
@@ -1151,6 +1278,7 @@ class ContinuousBatchingScheduler:
                 slot.generated.append(tok)
                 slot.pos += 1
                 self._tokens_emitted += 1
+                self._note_token(slot.request_id, i)
                 committed += 1
                 matched = j < len(draft) and draft[j] == tok
                 if matched:
@@ -1166,9 +1294,13 @@ class ContinuousBatchingScheduler:
             counts[i] = committed
             self.stats.tokens_drafted += len(draft)
             self.stats.tokens_accepted += accepted
+            if trc.enabled and draft:
+                trc.stream_acceptance(i, accepted / len(draft))
             if eng.adaptive_spec and draft:
                 self._accept_ewma[i] = 0.5 * self._accept_ewma[i] \
                     + 0.5 * accepted / len(draft)
+        if trc.enabled:
+            trc.end("accept", committed=sum(counts))
         eng.commit(counts)
         # a tick that commits m tokens counts m toward deadlines: the
         # scheduler clock stays in decode-step equivalents across modes
@@ -1202,8 +1334,15 @@ class ContinuousBatchingScheduler:
         every forced chain is trivial and no draft survived, so the
         caller runs the plain path instead."""
         eng = self.engine
+        trc = self.tracer
         ks = self._spec_ks(positions)
+        if trc.enabled:
+            trc.begin("draft")
         trees = self._draft_trees(ks)
+        if trc.enabled:
+            trc.end("draft",
+                    proposed=sum(len(t[0]) for t in trees
+                                 if t is not None))
         forced: Dict[int, List[int]] = {}
         for i, s in enumerate(self._slots):
             if s is not None:
@@ -1221,11 +1360,18 @@ class ContinuousBatchingScheduler:
                  + (len(trees[i][0]) if trees[i] is not None else 0)
                  for i in positions)
         k1 = max(1, min(k1, avail))
+        if trc.enabled:
+            trc.begin("prepare_decode")
         preempted = eng.prepare_decode(positions, n_new=k1)
+        if trc.enabled:
+            trc.end("prepare_decode", preempted=len(preempted))
         for i in sorted(preempted,
                         key=lambda j: self._slots[j].request_id,
                         reverse=True):
             s = self._slots[i]
+            if trc.enabled:
+                trc.instant("preempted", request_id=s.request_id,
+                            slot=i)
             self._queue.appendleft((s.request_id, s.request,
                                     list(s.generated)))
             self._slots[i] = None
@@ -1272,6 +1418,8 @@ class ContinuousBatchingScheduler:
         logits = eng.tree_verify(jnp.asarray(tok_np),
                                  jnp.asarray(dep_np),
                                  jnp.asarray(anc_np))
+        if trc.enabled:
+            trc.begin("accept")
         finite = np.asarray(eng.finite(logits))            # (B, k1)
         grid = np.asarray(eng.sample_grid(logits, keys, temps))
         cnts, path = self._tree_accept(
@@ -1315,6 +1463,7 @@ class ContinuousBatchingScheduler:
                     break
                 slot.generated.append(tok)
                 self._tokens_emitted += 1
+                self._note_token(slot.request_id, i)
                 committed += 1
                 if v:
                     accepted += 1
@@ -1331,11 +1480,15 @@ class ContinuousBatchingScheduler:
             new_tok_max = max(new_tok_max, committed)
             self.stats.tokens_drafted += nodes
             self.stats.tokens_accepted += accepted
+            if trc.enabled and nodes:
+                trc.stream_acceptance(i, accepted / nodes)
             if eng.adaptive_spec and nodes:
                 self._accept_ewma[i] = 0.5 * self._accept_ewma[i] \
                     + 0.5 * accepted / nodes
             if bad is not None:
                 quarantined.append((i, bad))
+        if trc.enabled:
+            trc.end("accept", committed=sum(counts))
         eng.commit(counts)
         self.stats.spec_ticks += 1
         # a tick that commits m tokens counts m toward deadlines: the
@@ -1360,12 +1513,15 @@ class ContinuousBatchingScheduler:
                  "slots": {i: s.request_id
                            for i, s in enumerate(self._slots)
                            if s is not None}}
-        raise LivelockError(
+        err = LivelockError(
             f"no progress (token committed, request terminated, or "
             f"retry consumed) in {stalled} consecutive scheduler "
             f"ticks; stuck requests: queued={stuck['queued']} "
             f"slots={stuck['slots']}; pool={self.engine.pool_snapshot()}",
             stuck=stuck, pool=self.engine.pool_snapshot())
+        if self.tracer.enabled:
+            self.tracer.attach(err)  # the stuck slots' last events
+        raise err
 
     def run(self) -> List[List[int]]:
         """Drain the queue; returns generated tokens (EOS included when
@@ -1375,11 +1531,19 @@ class ContinuousBatchingScheduler:
         :class:`LivelockError` after ``watchdog_limit`` consecutive
         ticks without progress instead of spinning."""
         stalled, last = 0, None
+        trc = self.tracer
         while self._queue or any(s is not None for s in self._slots):
             self._tick_no += 1
+            if trc.enabled:
+                trc.set_tick(self._tick_no)
+            before = self._tokens_emitted
             self._expire_deadlines()
             self._admit()
             self._tick()
+            if trc.enabled:
+                trc.tick_metrics(self._tokens_emitted - before,
+                                 len(self._queue),
+                                 self.engine.pool_gauges())
             if self.audit:
                 self.engine.check_invariants()
             snap = (self._tokens_emitted, len(self.outcomes),

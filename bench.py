@@ -30,6 +30,12 @@ so sub-20% claims are only resolvable by compiling both variants in ONE
 process and interleaving their samples A,B,A,B,... — see ``bench_ab``
 and the ``AB_PAIRS`` registry (flash d=64 exp2 / bf16-p / block-cap
 variants, fused-vs-jnp LN h1024).
+
+Serving configs run with a live ``Tracer`` and report its
+registry-derived tick-clock percentiles (``ttft_p50/p95/p99``,
+``itl_p50/p95/p99``) in ``extra``; ``--trace-out PATH`` additionally
+dumps each config's Perfetto/chrome-tracing JSONL with a config tag
+spliced into the filename.
 """
 
 import contextlib
@@ -613,6 +619,21 @@ def _decode_cost_numbers(cfg, slots, depth, param_dtype, cache_dtype,
             int(weight_read // slots))
 
 
+# `--trace-out PATH` (any position on the CLI) makes the serving
+# configs dump their tracer's Perfetto/chrome-tracing JSONL; each dump
+# splices a config tag in before the extension so one flag serves the
+# whole run. None = tracing stays on (the registry feeds the latency
+# percentiles either way) but nothing is written.
+_TRACE_OUT = None
+
+
+def _maybe_dump_trace(tracer, tag):
+    if not _TRACE_OUT or tracer is None or not tracer.enabled:
+        return
+    root, ext = os.path.splitext(_TRACE_OUT)
+    tracer.dump_jsonl(f"{root}.{tag}{ext or '.jsonl'}")
+
+
 def _serving_stats_probe():
     """Non-zero ``ServingStats`` counters from a tiny scheduler run
     under a pinned fault schedule (pool pressure + one injected fault
@@ -642,7 +663,33 @@ def _serving_stats_probe():
     return {k: v for k, v in sched.stats.as_dict().items() if v}
 
 
-def _spec_decode_setup(on_tpu, spec_k):
+def _observed_decode_probe():
+    """Registry-derived tick-clock latency percentiles (TTFT and
+    inter-token gaps, in ticks) from a tiny traced scheduler drain —
+    more submissions than slots, so the queue wait shows up in TTFT.
+    Deterministic: the tick clock is replay-exact, so these numbers
+    move only when scheduling behavior moves, never with host noise."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  PagedDecodeEngine, Request, Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    trc = Tracer()
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=32,
+                            num_pages=20, page_size=4, buckets=(16, 32),
+                            tracer=trc)
+    sched = ContinuousBatchingScheduler(eng, eos_id=-1)
+    for i in range(4):
+        sched.submit(Request(prompt=(7 + i, 11, 13), max_new_tokens=8))
+    sched.run()
+    _maybe_dump_trace(trc, "decode")
+    return trc.latency_summary()
+
+
+def _spec_decode_setup(on_tpu, spec_k, tracer=None):
     """Scheduler-driven decode over repetitive prompts (the n-gram
     drafter's home turf). Returns ``run() -> (tokens, stats)``: each
     call drains a FRESH scheduler over the same paged engine — the
@@ -650,7 +697,10 @@ def _spec_decode_setup(on_tpu, spec_k):
     calls measure the steady-state tick loop (host drafting, device
     verify, accept walk) and not compiles. ``spec_k=0`` builds the
     plain one-token-per-tick engine on the identical model/pool shape,
-    which is what the ``decode_spec_vs_plain`` A/B pair races."""
+    which is what the ``decode_spec_vs_plain`` A/B pair races; a
+    ``tracer`` rides through to the engine so the serving configs can
+    report registry-derived latency percentiles (and so the
+    ``decode_observed_vs_bare`` pair can price the hooks)."""
     import dataclasses as _dc
 
     from apex_tpu.models.gpt import gpt_tiny, init_gpt
@@ -663,7 +713,7 @@ def _spec_decode_setup(on_tpu, spec_k):
     max_new = 48 if on_tpu else 24
     eng = PagedDecodeEngine(params, cfg, num_slots=slots, max_len=128,
                             num_pages=128, page_size=8, buckets=(16,),
-                            spec_k=spec_k)
+                            spec_k=spec_k, tracer=tracer)
 
     def run():
         sched = ContinuousBatchingScheduler(eng, eos_id=-1)
@@ -678,7 +728,7 @@ def _spec_decode_setup(on_tpu, spec_k):
     return run, max_new * slots
 
 
-def _natural_spec_setup(on_tpu, mode, spec_k=4):
+def _natural_spec_setup(on_tpu, mode, spec_k=4, tracer=None):
     """Scheduler drain over a SEEDED NON-REPETITIVE workload — prompts
     drawn from a fixed PRNG over the whole vocab, so the n-gram
     drafter's suffix lookup has almost nothing to hit and any
@@ -711,7 +761,7 @@ def _natural_spec_setup(on_tpu, mode, spec_k=4):
         kw["tree_spec"] = True
     eng = PagedDecodeEngine(params, cfg, num_slots=slots, max_len=128,
                             num_pages=128, page_size=8, buckets=(16,),
-                            **kw)
+                            tracer=tracer, **kw)
     prompts = [tuple(int(t) for t in jax.random.randint(
         jax.random.PRNGKey(1234 + i), (12,), 0, cfg.vocab_size))
         for i in range(slots)]
@@ -735,11 +785,15 @@ def bench_gpt_spec_natural(on_tpu):
     committed-token rate, the acceptance rate, and m̄ — mean committed
     tokens per tick, the quantity the r13 break-even condition bounds
     (m̄ > 1.017 + draft_bytes/target_bytes)."""
+    from apex_tpu.serving import Tracer
+
     spec_k = 4
     for mode in ("ngram", "model", "tree"):
         metric = f"gpt_spec_natural_{mode}_accepted_tokens_per_s"
         try:
-            run, expect = _natural_spec_setup(on_tpu, mode, spec_k)
+            trc = Tracer()
+            run, expect = _natural_spec_setup(on_tpu, mode, spec_k,
+                                              tracer=trc)
             run()  # compile prefill/verify + warm the draft path
             best = total = ticks = stats = None
             for _ in range(3 if on_tpu else 1):
@@ -748,14 +802,18 @@ def bench_gpt_spec_natural(on_tpu):
                 dtr = time.perf_counter() - t0
                 best = dtr if best is None else min(best, dtr)
             assert total == expect, (total, expect)
-            emit(metric, total / best, "tokens/sec",
-                 extra={"spec_k": spec_k, "tokens": total, "ticks": ticks,
-                        "mean_committed_per_tick":
-                            round(total / max(ticks, 1), 4),
-                        "acceptance_rate":
-                            round(stats.acceptance_rate, 4),
-                        "tokens_drafted": stats.tokens_drafted,
-                        "tokens_accepted": stats.tokens_accepted})
+            extra = {"spec_k": spec_k, "tokens": total, "ticks": ticks,
+                     "mean_committed_per_tick":
+                         round(total / max(ticks, 1), 4),
+                     "acceptance_rate":
+                         stats.as_dict()["acceptance_rate"],
+                     "tokens_drafted": stats.tokens_drafted,
+                     "tokens_accepted": stats.tokens_accepted}
+            # registry-derived tick-clock percentiles (ttft_p50/...,
+            # itl_p50/... — deterministic, unlike the wall timings)
+            extra.update(trc.latency_summary())
+            _maybe_dump_trace(trc, f"spec_natural_{mode}")
+            emit(metric, total / best, "tokens/sec", extra=extra)
         except Exception as e:  # one mode must never sink the others
             print(json.dumps({"metric": metric,
                               "error": repr(e)[:200]}), flush=True)
@@ -767,8 +825,11 @@ def _bench_spec_decode(on_tpu):
     acceptance rate the roofline math keys on in ``extra`` (BASELINE
     r11: the verify step beats plain paged decode on bytes per
     accepted token whenever expected commits/tick exceed ~1.017)."""
+    from apex_tpu.serving import Tracer
+
     spec_k = 4
-    run, expect = _spec_decode_setup(on_tpu, spec_k)
+    trc = Tracer()
+    run, expect = _spec_decode_setup(on_tpu, spec_k, tracer=trc)
     run()  # compile prefill/verify + warm the host draft path
     best, total, stats = None, 0, None
     for _ in range(3 if on_tpu else 1):
@@ -777,11 +838,14 @@ def _bench_spec_decode(on_tpu):
         dtr = time.perf_counter() - t0
         best = dtr if best is None else min(best, dtr)
     assert total == expect, (total, expect)  # eos_id=-1: full streams
+    extra = {"spec_k": spec_k, "tokens": total,
+             "acceptance_rate": stats.as_dict()["acceptance_rate"],
+             "tokens_drafted": stats.tokens_drafted,
+             "tokens_accepted": stats.tokens_accepted}
+    extra.update(trc.latency_summary())
+    _maybe_dump_trace(trc, "spec")
     emit("gpt_spec_accepted_tokens_per_s", total / best, "tokens/sec",
-         extra={"spec_k": spec_k, "tokens": total,
-                "acceptance_rate": round(stats.acceptance_rate, 4),
-                "tokens_drafted": stats.tokens_drafted,
-                "tokens_accepted": stats.tokens_accepted})
+         extra=extra)
 
 
 def bench_gpt_decode(on_tpu):
@@ -824,6 +888,14 @@ def bench_gpt_decode(on_tpu):
         extra["serving_stats"] = _serving_stats_probe()
     except Exception as e:  # robustness probe must never sink the bench
         extra["serving_stats_error"] = repr(e)
+    try:
+        # tick-clock TTFT / inter-token percentiles from the tracer
+        # registry: the observability layer's own export, tracked here
+        # so a scheduling regression shows up as a latency shift even
+        # when raw throughput holds
+        extra.update(_observed_decode_probe())
+    except Exception as e:  # observability probe must never sink it
+        extra["observed_latency_error"] = repr(e)
     emit(metric, slots / dt, "tokens/sec", extra=extra)
     try:
         _bench_spec_decode(on_tpu)
@@ -938,6 +1010,41 @@ def _spec_vs_plain_decode_ab_pair(on_tpu):
         return sample
 
     return side(4), side(0)
+
+
+def _observed_vs_bare_decode_ab_pair(on_tpu):
+    """(side_a, side_b): the plain scheduler drain with a live tracer
+    vs the same drain with the inert default — prices the
+    observability hooks themselves, scored as seconds per committed
+    token. The no-op path is one attribute check per hook site (the
+    fault-injector contract), so the honest expectation is a ratio
+    indistinguishable from 1.0; this pair is the standing receipt. The
+    traced side clears its event log each sample so list-append cost
+    doesn't compound across rounds, and each sample takes the best of
+    three drains — single full-drain timings on this pair swing +-15%
+    with host noise, far above the effect being priced."""
+    from apex_tpu.serving import Tracer
+
+    def side(traced):
+        trc = Tracer() if traced else None
+        run, _ = _spec_decode_setup(on_tpu, 0, tracer=trc)
+        run()  # compile + warm
+
+        def sample():
+            best = None
+            for _ in range(3):
+                if trc is not None:
+                    trc.events.clear()
+                    trc.recorder.clear()
+                t0 = time.perf_counter()
+                n, _ = run()
+                dt = (time.perf_counter() - t0) / n
+                best = dt if best is None else min(best, dt)
+            return best
+
+        return sample
+
+    return side(True), side(False)
 
 
 def _decode_cache_ab_pair(on_tpu):
@@ -1508,6 +1615,9 @@ AB_PAIRS = {
     "decode_spec_vs_plain": (
         "spec_k4", "plain",
         _spec_vs_plain_decode_ab_pair),
+    "decode_observed_vs_bare": (
+        "trace_on", "noop_hooks",
+        _observed_vs_bare_decode_ab_pair),
     "decode_w8_vs_bf16": (
         "w8_weights", "bf16_weights",
         _w8_decode_ab_pair),
@@ -1998,8 +2108,20 @@ DEFAULT_CAP_S = 480
 
 
 def main():
+    global _TRACE_OUT
+
     from apex_tpu.utils.platform import has_tpu
 
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        try:
+            _TRACE_OUT = sys.argv[i + 1]
+        except IndexError:
+            print(json.dumps({"metric": "trace_out",
+                              "error": "--trace-out needs a path"}),
+                  flush=True)
+            return
+        del sys.argv[i:i + 2]
     if len(sys.argv) > 1 and sys.argv[1] == "ab":
         # targeted A/B runs: `python bench.py ab [pair ...]` (no pair
         # names = the whole registry). Same code path as the ab_kernels
@@ -2041,9 +2163,11 @@ def main():
             continue
         cap = min(CAP_S.get(name, DEFAULT_CAP_S), remaining)
         try:
+            argv = [sys.executable, os.path.abspath(__file__), name]
+            if _TRACE_OUT:
+                argv += ["--trace-out", _TRACE_OUT]
             r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), name],
-                capture_output=True, text=True, timeout=cap)
+                argv, capture_output=True, text=True, timeout=cap)
         except subprocess.TimeoutExpired as e:
             out = e.stdout or b""
             if isinstance(out, bytes):

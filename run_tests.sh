@@ -13,7 +13,10 @@
 #                           #     randomized fault schedules, typed
 #                           #     outcomes, pool invariants audited
 #                           #     after every tick, bit-identity of
-#                           #     unaffected streams
+#                           #     unaffected streams. Runs fully traced
+#                           #     and dumps the Perfetto JSONL trace to
+#                           #     $APEX_CHAOS_TRACE_OUT (defaulted below;
+#                           #     CI uploads it as an artifact)
 #   ./run_tests.sh gate     # L1 loss-curve gate: amp levels AND the
 #                           #     reduced-precision optimizer-state modes
 #                           #     (bf16 m, fused cast-out) must track the
@@ -45,7 +48,13 @@ case "$tier" in
   L1)    exec python -m pytest tests/L1 -q "$@" ;;
   all)   exec python -m pytest tests -q "$@" ;;
   quick) exec python -m pytest tests -q -m quick "$@" ;;
-  chaos) exec python -m pytest tests -q -m chaos "$@" ;;
+  chaos) # per-seed trace dumps land next to this path (a tag + seed
+         # suffix is spliced in before the extension); set it empty to
+         # disable the dump
+         : "${APEX_CHAOS_TRACE_OUT=$(mktemp -d)/apex_chaos_trace.jsonl}"
+         export APEX_CHAOS_TRACE_OUT
+         echo "chaos traces: ${APEX_CHAOS_TRACE_OUT:-disabled}" >&2
+         exec python -m pytest tests -q -m chaos "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py \
              tests/L1/test_quant_eval_parity.py -q "$@" ;;
   lint)  # combined AST + VMEM + trace + cost + sharding tiers, under a

@@ -1,0 +1,21 @@
+# apxlint: fixture
+# Known-bad: the serving observability layer (serving.observe) is
+# registered host state — tracer flags, metric registries, and
+# flight-recorder rings mutate between scheduler ticks, so consulting
+# any of them inside a jitted decode body freezes one stale value into
+# the compiled program. Both reads must raise APX401.
+import jax
+
+from apex_tpu.serving import MetricsRegistry
+from apex_tpu.serving.observe import Tracer
+
+REGISTRY = MetricsRegistry()
+TRACER = Tracer()
+
+
+@jax.jit
+def decode_body(logits):
+    if TRACER.enabled:
+        logits = logits * 0.0
+    scale = REGISTRY.counter("serving_retries_total").value
+    return logits * (1.0 + scale)

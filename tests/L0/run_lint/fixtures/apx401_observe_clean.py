@@ -1,0 +1,22 @@
+# apxlint: fixture
+# Known-clean: the same observability state consulted from plain host
+# code — the scheduler's hook-site pattern (`if trc.enabled:` between
+# ticks, never reachable from a traced root) — raises nothing.
+import jax
+
+from apex_tpu.serving import MetricsRegistry
+from apex_tpu.serving.observe import Tracer
+
+REGISTRY = MetricsRegistry()
+TRACER = Tracer()
+
+
+def host_tick_report():
+    if TRACER.enabled:
+        TRACER.instant("tick")
+    return REGISTRY.as_dict()
+
+
+@jax.jit
+def decode_body(logits):
+    return logits * 2.0

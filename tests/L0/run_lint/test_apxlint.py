@@ -79,6 +79,14 @@ def test_apx401_serving_host_state():
     assert _codes("apx401_hoststate_clean.py") == []
 
 
+def test_apx401_observe_host_state():
+    # the observability layer is host state too: a Tracer flag check
+    # and a MetricsRegistry counter read inside a jitted decode body
+    codes = _codes("apx401_observe_bad.py")
+    assert codes.count("APX401") == 2, codes
+    assert _codes("apx401_observe_clean.py") == []
+
+
 def test_apx402_global_write():
     assert _codes("apx402_bad.py") == ["APX402"]
 

@@ -16,6 +16,7 @@ always run to ``max_new_tokens`` and prefix assertions are exact.
 """
 
 import dataclasses
+import os
 
 import jax
 import pytest
@@ -25,6 +26,7 @@ from apex_tpu.serving import (
     AdmissionRejected, ContinuousBatchingScheduler, DeadlineExceeded,
     DecodeEngine, FaultInjector, LivelockError, PagedDecodeEngine,
     PoolInvariantError, Request, RetryBudgetExhausted, FINISH_REASONS,
+    Tracer,
 )
 from apex_tpu.serving.faults import SITES, fault_draw
 
@@ -43,6 +45,10 @@ def model():
 
 def _engine(model, injector=None, num_slots=2, num_pages=20, **kw):
     cfg, params = model
+    # tracing is ON for the whole chaos tier: every bit-identity /
+    # golden-equality contract below must hold with the observability
+    # hooks live (they are host-side and must never perturb a stream)
+    kw.setdefault("tracer", Tracer())
     return PagedDecodeEngine(params, cfg, num_slots=num_slots,
                              max_len=MAX_LEN, num_pages=num_pages,
                              page_size=4, buckets=(16, 32),
@@ -472,6 +478,16 @@ def test_multi_fault_chaos_is_typed_prefixed_and_replayable(model, seed):
     assert replay.outcomes == sched.outcomes
     assert replay.stats.as_dict() == sched.stats.as_dict()
     assert replay.engine.injector.counts == sched.engine.injector.counts
+    # the deterministic tick-clock trace stream replays byte-exactly
+    assert replay.engine.tracer.tick_stream() \
+        == sched.engine.tracer.tick_stream()
+    # CI post-mortem artifact: run_tests.sh chaos points this env var
+    # at a tmp path and the workflow uploads the dumps
+    out = os.environ.get("APEX_CHAOS_TRACE_OUT")
+    if out:
+        root, ext = os.path.splitext(out)
+        sched.engine.tracer.dump_jsonl(
+            f"{root}.seed{seed}{ext or '.jsonl'}")
 
 @pytest.mark.slow
 def test_multi_fault_chaos_on_int8_pool(model):
