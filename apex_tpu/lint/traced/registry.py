@@ -651,6 +651,79 @@ def _paged_prefill_step_entry():
     return build
 
 
+def _chunk_prefill_step_entry():
+    """Dense chunked prefill: one 16-token prompt chunk written at a
+    dynamic start row (the scheduler's chunk_tokens bucket — exactly
+    one executable per chunk size). Same 3-leaf cache donation as the
+    monolithic prefill step."""
+    def build():
+        from apex_tpu.serving.decode import make_chunk_prefill_fn
+
+        cfg = _serving_cfg()
+        params, cache = _serving_args(cfg)
+        fn = make_chunk_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 16), "int32"),
+                    _sds((16,), "int32"), _sds((), "int32"),
+                    _sds((), "int32"))
+
+    return build
+
+
+def _paged_chunk_prefill_step_entry():
+    """Paged chunked prefill: a 16-token = one-page chunk scattered to
+    ``write_pages`` while attention gathers through the slot's real
+    ``gather_row`` (earlier chunks + shared prefix visible) and
+    ``store_row`` lands in the block table — the same 4-leaf donated
+    cache as monolithic paged prefill."""
+    def build():
+        from apex_tpu.serving.decode import make_paged_chunk_prefill_fn
+
+        cfg = _serving_cfg()
+        params, cache = _paged_serving_args(cfg)
+        fn = make_paged_chunk_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 16), "int32"),
+                    _sds((16,), "int32"), _sds((), "int32"),
+                    _sds((), "int32"), _sds((1,), "int32"),
+                    _sds((2,), "int32"), _sds((2,), "int32"))
+
+    return build
+
+
+def _paged_chunk_prefill_step_medium_entry():
+    """r14 cost anchor: one 256-token chunk of a long prompt at the
+    ragged medium pool shape (32 slots, s_max 512, page 64, bf16
+    params). Its budgets.json row against the monolithic-prefill read
+    pins the chunking price: ~chunk/S of the parameter+activation work
+    plus the re-read of the cache written so far — the bytes the
+    scheduler trades for bounded p99 inter-token latency."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig, init_gpt
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.decode import make_paged_chunk_prefill_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        params = jax.eval_shape(
+            lambda k: init_gpt(k, cfg, jnp.bfloat16), jax.random.PRNGKey(0))
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        fn = make_paged_chunk_prefill_fn(cfg)
+        return fn, (params, cache, _sds((1, 256), "int32"),
+                    _sds((256,), "int32"), _sds((), "int32"),
+                    _sds((), "int32"), _sds((4,), "int32"),
+                    _sds((8,), "int32"), _sds((8,), "int32"))
+
+    return build
+
+
 def _paged_decode_step_entry(tp=None):
     """Paged decode: scatter the new row through the block table, then
     gather each slot's pages and attend (APX105 pins this file's
@@ -1216,6 +1289,19 @@ def repo_entries() -> List[TraceEntry]:
                    _paged_prefill_step_entry(),
                    checks=("precision", "memory", "aliases"),
                    min_alias_pairs=4),
+        # chunked prefill: the same donations as the monolithic steps
+        # (3 dense leaves / 4 paged leaves) — a dropped pair would
+        # re-allocate the whole cache EVERY CHUNK, multiplying the
+        # admission cost by the chunk count
+        TraceEntry("gpt_chunk_prefill_step", "apex_tpu.serving.decode",
+                   _chunk_prefill_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=3),
+        TraceEntry("gpt_paged_chunk_prefill_step",
+                   "apex_tpu.serving.decode",
+                   _paged_chunk_prefill_step_entry(),
+                   checks=("precision", "memory", "aliases"),
+                   min_alias_pairs=4),
         TraceEntry("gpt_paged_decode_step", "apex_tpu.serving.decode",
                    _paged_decode_step_entry(),
                    checks=("precision", "memory", "aliases"),
@@ -1262,6 +1348,12 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_spec_verify_step_medium_ragged",
                    "apex_tpu.serving.decode",
                    _spec_verify_step_medium_ragged_entry(), checks=()),
+        # r14: one chunk of a chunked prefill at the same ragged
+        # medium shape — budgets.json pins the per-chunk HBM bytes
+        # (~chunk/S of the monolithic read plus the cache re-read)
+        TraceEntry("gpt_paged_chunk_prefill_step_medium",
+                   "apex_tpu.serving.decode",
+                   _paged_chunk_prefill_step_medium_entry(), checks=()),
         # r13: the model drafter's per-token forward at the medium
         # shape — the draft_bytes numerator of the break-even condition
         # (BASELINE.md r13); its hand-tightened ceiling pins the draft

@@ -416,6 +416,14 @@ def _paged_serving_cfg(which):
             return fn, (params, cache, _sds((1, 16), "int32"),
                         _sds((16,), "int32"), _sds((), "int32"),
                         _sds((1,), "int32"), _sds((2,), "int32"))
+        if which == "chunk_prefill":
+            from apex_tpu.serving.decode import make_paged_chunk_prefill_fn
+
+            fn = make_paged_chunk_prefill_fn(cfg)
+            return fn, (params, cache, _sds((1, 16), "int32"),
+                        _sds((16,), "int32"), _sds((), "int32"),
+                        _sds((), "int32"), _sds((1,), "int32"),
+                        _sds((2,), "int32"), _sds((2,), "int32"))
         if which == "verify":
             from apex_tpu.serving.decode import make_paged_verify_fn
 
@@ -488,6 +496,9 @@ def repo_configs() -> List[Config]:
                        _w8_matmul_cfg()))
     cfgs.append(Config("gpt_paged_prefill_step", "apex_tpu.serving.decode",
                        _paged_serving_cfg("prefill")))
+    cfgs.append(Config("gpt_paged_chunk_prefill_step",
+                       "apex_tpu.serving.decode",
+                       _paged_serving_cfg("chunk_prefill")))
     cfgs.append(Config("gpt_paged_decode_step", "apex_tpu.serving.decode",
                        _paged_serving_cfg("decode")))
     cfgs.append(Config("gpt_spec_verify_step", "apex_tpu.serving.decode",

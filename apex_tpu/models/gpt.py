@@ -588,6 +588,139 @@ def _block_verify_paged(lp, x, k_pages, v_pages, block_tables, pos, cfg,
     return x + mlp, k_pages, v_pages
 
 
+def _chunk_prefill_attention(q_k_v: jax.Array, k_cache: jax.Array,
+                             v_cache: jax.Array, slot: jax.Array,
+                             pos: jax.Array, cfg: GPTConfig,
+                             rope_freqs: Optional[jax.Array],
+                             key_mask: jax.Array):
+    """Chunked-prefill attention for ONE slot against the dense cache:
+    the prompt-sized generalization of :func:`_verify_attention`.
+
+    ``q_k_v`` is (1, sc, 3*h_local) — one chunk of one slot's prompt,
+    projected together; ``slot``/``pos`` are scalar int32 (cache row
+    and the chunk's absolute start position, so token j sits at
+    ``pos + j``); ``key_mask`` (1, sc) int32 marks real tokens (the
+    final chunk of a prompt is bucket-padded at the tail). The chunk's
+    k/v rows are zero-masked and written at ``pos`` BEFORE attending —
+    write-then-attend, so the per-query ``s <= pos + j`` mask admits
+    exactly the previously-written chunks plus the token's own prefix,
+    and logits at row j equal a teacher-forced forward at position
+    ``pos + j``. Pad queries (mask 0) attend only zeroed rows beyond
+    every real query's mask, so their garbage context is unreachable
+    from any real row's output. Scores/softmax run in fp32."""
+    _, sc, _ = q_k_v.shape
+    hd = cfg.head_dim
+    q, k, v = _split_qkv(q_k_v, hd)            # (1, nh_local, sc, hd)
+    p1 = pos[None]
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=p1)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=p1)
+    mz = key_mask.astype(k.dtype)[:, None, :, None]
+    k_cache = lax.dynamic_update_slice(
+        k_cache, (k * mz).astype(k_cache.dtype), (slot, 0, pos, 0))
+    v_cache = lax.dynamic_update_slice(
+        v_cache, (v * mz).astype(v_cache.dtype), (slot, 0, pos, 0))
+    kg = lax.dynamic_slice(k_cache, (slot, 0, 0, 0),
+                           (1,) + k_cache.shape[1:])
+    vg = lax.dynamic_slice(v_cache, (slot, 0, 0, 0),
+                           (1,) + v_cache.shape[1:])
+    s_max = kg.shape[2]
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) / math.sqrt(hd)
+    qpos = p1[:, None] + jnp.arange(sc)[None, :]         # (1, sc)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= qpos[:, None, :, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     vg.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(1, sc, -1), k_cache, v_cache
+
+
+def _block_chunk_prefill(lp, x, k_cache, v_cache, slot, pos, cfg,
+                         rope_freqs, key_mask, qkv_fn, out_fn, fc1_fn,
+                         fc2_fn):
+    """:func:`_block_verify` for one slot's prompt chunk."""
+    att, k_cache, v_cache = _chunk_prefill_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_cache, v_cache, slot, pos, cfg, rope_freqs, key_mask)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_cache, v_cache
+
+
+def _paged_chunk_prefill_attention(q_k_v: jax.Array, k_pages: jax.Array,
+                                   v_pages: jax.Array,
+                                   write_pages: jax.Array,
+                                   gather_row: jax.Array, pos: jax.Array,
+                                   cfg: GPTConfig,
+                                   rope_freqs: Optional[jax.Array],
+                                   key_mask: jax.Array):
+    """:func:`_chunk_prefill_attention` over the PAGED pool. Chunks are
+    whole pages (sc a multiple of page_size), so the write is the
+    monolithic paged prefill's page-granular scatter: the chunk's
+    zero-masked k/v rows are cut into page tiles and scattered to
+    ``write_pages`` ((sc // page_size,) int32 — the host redirects
+    prefix-shared pages to ``SCRATCH_PAGE``, so shared pages are never
+    rewritten). The attend gathers through ``gather_row`` ((max_pages,)
+    int32, the slot's real NULL-padded block-table row) — it is passed
+    SEPARATELY from the row the core stores, because the scheduler
+    parks the stored row on scratch until the final chunk (mid-prefill
+    decode/verify writes by co-tenant steps must land on scratch, not
+    on a shared page). Exact-zero masking keeps the result placement-
+    invariant, as in :func:`_paged_decode_attention`."""
+    _, sc, _ = q_k_v.shape
+    hd = cfg.head_dim
+    page_size = k_pages.shape[2]
+    n_chunk_pages = sc // page_size
+    q, k, v = _split_qkv(q_k_v, hd)            # (1, nh_local, sc, hd)
+    p1 = pos[None]
+    if rope_freqs is not None:
+        q = fused_apply_rotary_pos_emb_bhsd(q, rope_freqs, positions=p1)
+        k = fused_apply_rotary_pos_emb_bhsd(k, rope_freqs, positions=p1)
+    mz = key_mask.astype(k.dtype)[:, None, :, None]
+
+    def tiles(t, dtype):
+        # (1, nh, sc, hd) -> page tiles (n_chunk_pages, nh, page, hd),
+        # zero-masked pad rows included (scratch eats redirected pages)
+        t = (t * mz)[0]
+        t = t.reshape(t.shape[0], n_chunk_pages, page_size, hd)
+        return t.transpose(1, 0, 2, 3).astype(dtype)
+
+    k_pages = k_pages.at[write_pages].set(tiles(k, k_pages.dtype))
+    v_pages = v_pages.at[write_pages].set(tiles(v, v_pages.dtype))
+    kg = k_pages[gather_row][None].transpose(0, 2, 1, 3, 4)
+    vg = v_pages[gather_row][None].transpose(0, 2, 1, 3, 4)
+    s_max = kg.shape[2] * kg.shape[3]
+    kg = kg.reshape(1, kg.shape[1], s_max, hd)
+    vg = vg.reshape(1, vg.shape[1], s_max, hd)
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) / math.sqrt(hd)
+    qpos = p1[:, None] + jnp.arange(sc)[None, :]         # (1, sc)
+    valid = jnp.arange(s_max)[None, None, None, :] \
+        <= qpos[:, None, :, None]
+    scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                     vg.astype(jnp.float32)).astype(q_k_v.dtype)
+    return ctx.transpose(0, 2, 1, 3).reshape(1, sc, -1), k_pages, v_pages
+
+
+def _block_chunk_prefill_paged(lp, x, k_pages, v_pages, write_pages,
+                               gather_row, pos, cfg, rope_freqs,
+                               key_mask, qkv_fn, out_fn, fc1_fn, fc2_fn):
+    """:func:`_block_chunk_prefill` over the paged pool."""
+    att, k_pages, v_pages = _paged_chunk_prefill_attention(
+        qkv_fn(lp["qkv"], _ln(lp["ln1"], x, cfg.layer_norm_eps)),
+        k_pages, v_pages, write_pages, gather_row, pos, cfg, rope_freqs,
+        key_mask)
+    x = x + out_fn(lp["out"], att)
+    mlp = fc2_fn(lp["fc2"], jax.nn.gelu(
+        fc1_fn(lp["fc1"], _ln(lp["ln2"], x, cfg.layer_norm_eps))))
+    return x + mlp, k_pages, v_pages
+
+
 # ---------------------------------------------------------------------------
 # tree verify: one forward scores a whole draft TREE (SpecInfer-style).
 # The linear `s <= pos + j` mask generalizes to an ancestor matrix: key

@@ -31,7 +31,11 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   turns ticks into draft → verify → accept steps committing 1..k+1
   tokens per slot, with optional model drafting (``draft_model=``),
   tree speculation (``tree_spec=True``) and per-stream adaptive depth
-  (``adaptive_spec=True``);
+  (``adaptive_spec=True``); ``chunk_tokens=`` switches admission to
+  chunked prefill — page-aligned prompt chunks run between decode
+  ticks under a ``tick_token_budget``, bounding p99 inter-token
+  latency under mixed load while keeping committed streams
+  bit-identical;
 - ``health``    — typed failure taxonomy (``PoolExhausted``,
   ``NonFiniteLogits``, ``RetryBudgetExhausted``, ...), per-engine
   ``ServingStats`` counters, and typed ``RequestOutcome`` records;
@@ -52,9 +56,11 @@ from apex_tpu.serving.cache import (  # noqa: F401
     init_cache, init_paged_cache, paged_cache_partition_specs,
 )
 from apex_tpu.serving.decode import (  # noqa: F401
-    make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
+    make_chunk_prefill_fn, make_copy_page_fn, make_decode_fn,
+    make_paged_chunk_prefill_fn, make_paged_decode_fn,
     make_paged_prefill_fn, make_paged_tree_verify_fn,
-    make_paged_verify_fn, make_prefill_fn, make_tp_decode_fn,
+    make_paged_verify_fn, make_prefill_fn, make_tp_chunk_prefill_fn,
+    make_tp_decode_fn, make_tp_paged_chunk_prefill_fn,
     make_tp_paged_decode_fn, make_tp_paged_prefill_fn,
     make_tp_paged_tree_verify_fn, make_tp_paged_verify_fn,
     make_tp_prefill_fn, make_tp_tree_verify_fn, make_tp_verify_fn,

@@ -32,6 +32,26 @@ Contracts:
   rejected candidate's row is simply never admitted by any later mask
   before the next step re-writes it. That is the whole rollback
   contract, and it is pinned by bit-identity tests.
+- **chunk prefill** runs the prompt forward INCREMENTALLY: one chunk of
+  ``chunk_tokens`` positions per call, write-then-attend against the
+  live cache at absolute positions (the verify mechanics applied to
+  prefill, per Sarathi-Serve). Each call writes the chunk's K/V rows
+  and advances the slot length to the chunk's end; the last call's
+  logits row (at the last REAL token — the final chunk is the only
+  padded one) is the first sampling input. One jitted, donated
+  executable per (chunk bucket, cache shape) — every chunk pads to the
+  same ``chunk_tokens`` bucket. On the paged path chunks are whole
+  pages, so the write is the same page-granular scatter as monolithic
+  paged prefill; the attend gathers through a ``gather_row`` passed
+  separately from the ``store_row`` the core installs, because the
+  scheduler keeps the stored row parked on ``SCRATCH_PAGE`` until the
+  final chunk (co-tenant decode/verify steps write a row for EVERY
+  slot each tick — mid-prefill those garbage writes must land on
+  scratch, never on a prefix-shared page). Refused for the int8 pool:
+  chunk queries would re-read earlier chunks' k/v dequantized while
+  monolithic prefill attends them fresh in bf16, so first-token logits
+  could drift from the synchronous path beyond the bit-identity
+  contract.
 - **tree verify** generalizes verify to a draft TREE per slot: node j
   (topological order, node 0 = the pending token) writes its K/V at
   physical row ``pos + j`` but attends at position ``pos + depth[j]``
@@ -53,10 +73,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.models.gpt import (
-    GPTConfig, GPTModel, _block_decode, _block_decode_paged,
-    _block_decode_paged_q8, _block_prefill, _block_tree_verify,
-    _block_tree_verify_paged, _block_verify, _block_verify_paged,
-    _block_verify_paged_q8, _ln, _rope_or_none, _tied_lm_logits,
+    GPTConfig, GPTModel, _block_chunk_prefill, _block_chunk_prefill_paged,
+    _block_decode, _block_decode_paged, _block_decode_paged_q8,
+    _block_prefill, _block_tree_verify, _block_tree_verify_paged,
+    _block_verify, _block_verify_paged, _block_verify_paged_q8, _ln,
+    _rope_or_none, _tied_lm_logits,
 )
 from apex_tpu.serving.cache import (
     KVCache, PagedKVCache, cache_partition_specs,
@@ -193,6 +214,46 @@ def _tree_verify_core(params, cfg: GPTConfig, cache: KVCache, tokens,
     hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
     logits = logits_fn(params, hidden)
     return KVCache(k, v, _self_rewrite(pos)), logits
+
+
+def _chunk_prefill_core(params, cfg: GPTConfig, cache: KVCache, ids,
+                        mask, slot, pos, *, embed_fn, dense_fns,
+                        logits_fn):
+    """Chunked prefill: ids (1, chunk_tokens) — one chunk of one slot's
+    prompt, already padded to the chunk bucket; mask (chunk_tokens,)
+    int32 with 1 = real token (all-ones except the final chunk); slot
+    and pos scalar int32 (cache row, absolute start position). Runs the
+    verify-style write-then-attend forward over the chunk, advances the
+    slot length to ``pos + sum(mask)`` (= the true prompt length after
+    the final chunk), and returns (cache', logits (1, V)) with the
+    logits taken at the chunk's last REAL token — only the final
+    chunk's row is a sampling input; earlier chunks' rows are
+    discarded by the caller."""
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(f"chunk prefill takes one slot's (1, sc) ids, "
+                         f"got {ids.shape}")
+    sc = ids.shape[1]
+    if sc > cache.k.shape[3]:
+        raise ValueError(f"chunk bucket {sc} exceeds cache max_len "
+                         f"{cache.k.shape[3]}")
+    x = embed_fn(params, ids, pos=pos[None])
+    freqs = _rope_or_none(cfg, cache.k.shape[3])
+    key_mask = mask[None, :]
+
+    def body(x, layer_slice):
+        lp, kc, vc = layer_slice
+        x, kc, vc = _block_chunk_prefill(lp, x, kc, vc, slot, pos, cfg,
+                                         freqs, key_mask, *dense_fns)
+        return x, (kc, vc)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    n_real = jnp.sum(mask).astype(jnp.int32)
+    h_last = lax.dynamic_slice_in_dim(hidden, n_real - 1, 1, 1)[:, 0]
+    logits = logits_fn(params, h_last)
+    lengths = lax.dynamic_update_slice(cache.lengths,
+                                       (pos + n_real)[None], (slot,))
+    return KVCache(k, v, lengths), logits
 
 
 # ---------------------------------------------------------------------------
@@ -390,6 +451,66 @@ def _paged_tree_verify_core(params, cfg: GPTConfig, cache: PagedKVCache,
     logits = logits_fn(params, hidden)
     return PagedKVCache(k, v, _self_rewrite(pos), _self_rewrite(bt)), \
         logits
+
+
+def _paged_chunk_prefill_core(params, cfg: GPTConfig,
+                              cache: PagedKVCache, ids, mask, slot, pos,
+                              write_pages, gather_row, store_row, *,
+                              embed_fn, dense_fns, logits_fn):
+    """:func:`_chunk_prefill_core` over the page pool. Chunks are whole
+    pages, so the write is the monolithic paged prefill's page-granular
+    scatter to ``write_pages`` (prefix-shared pages redirected to
+    ``SCRATCH_PAGE`` by the host); the attend gathers through
+    ``gather_row`` (the slot's real NULL-padded row) while
+    ``store_row`` becomes the slot's block-table row — the scheduler
+    passes an all-scratch parked row until the final chunk, so
+    co-tenant decode/verify writes mid-prefill land on scratch (see the
+    module docstring). Refused for the int8 pool: chunk queries would
+    re-read earlier chunks dequantized where monolithic prefill attends
+    fresh bf16 values, drifting first-token logits off the synchronous
+    path."""
+    if cache.k_scale is not None:
+        raise ValueError("chunked prefill is not offered over the int8 "
+                         "page pool (kv8 keeps monolithic prefill)")
+    if ids.ndim != 2 or ids.shape[0] != 1:
+        raise ValueError(f"chunk prefill takes one slot's (1, sc) ids, "
+                         f"got {ids.shape}")
+    sc = ids.shape[1]
+    page_size = cache.k.shape[3]
+    if sc % page_size:
+        raise ValueError(f"chunk bucket {sc} is not a multiple of "
+                         f"page_size {page_size}")
+    n_chunk_pages = sc // page_size
+    if write_pages.shape != (n_chunk_pages,):
+        raise ValueError(f"write_pages {write_pages.shape} != one page "
+                         f"per chunk page ({n_chunk_pages},)")
+    max_pages = cache.block_tables.shape[1]
+    for name, row in (("gather_row", gather_row),
+                      ("store_row", store_row)):
+        if row.shape != (max_pages,):
+            raise ValueError(f"{name} {row.shape} != block-table row "
+                             f"({max_pages},)")
+    x = embed_fn(params, ids, pos=pos[None])
+    freqs = _rope_or_none(cfg, max_pages * page_size)
+    key_mask = mask[None, :]
+
+    def body(x, layer_slice):
+        lp, kp, vp = layer_slice
+        x, kp, vp = _block_chunk_prefill_paged(
+            lp, x, kp, vp, write_pages, gather_row, pos, cfg, freqs,
+            key_mask, *dense_fns)
+        return x, (kp, vp)
+
+    x, (k, v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    hidden = _ln(params["final_ln"], x, cfg.layer_norm_eps)
+    n_real = jnp.sum(mask).astype(jnp.int32)
+    h_last = lax.dynamic_slice_in_dim(hidden, n_real - 1, 1, 1)[:, 0]
+    logits = logits_fn(params, h_last)
+    lengths = lax.dynamic_update_slice(cache.lengths,
+                                       (pos + n_real)[None], (slot,))
+    block_tables = lax.dynamic_update_slice(
+        cache.block_tables, store_row[None, :], (slot, 0))
+    return PagedKVCache(k, v, lengths, block_tables), logits
 
 
 # ---------------------------------------------------------------------------
@@ -613,6 +734,42 @@ def make_paged_tree_verify_fn(cfg: GPTConfig, compute_dtype=None,
                                        logits_fn=logits_fn)
 
     return jax.jit(verify, donate_argnums=1)
+
+
+def make_chunk_prefill_fn(cfg: GPTConfig, compute_dtype=None,
+                          quantized=False):
+    """jit(chunked prefill) with the cache DONATED (3 alias pairs: k,
+    v, lengths). One compiled executable per (chunk bucket, cache
+    shape) — the scheduler pads every chunk to the same
+    ``chunk_tokens`` bucket, so this compiles once per engine."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
+
+    def chunk_prefill(params, cache, ids, mask, slot, pos):
+        return _chunk_prefill_core(params, cfg, cache, ids, mask, slot,
+                                   pos, embed_fn=embed,
+                                   dense_fns=dense_fns,
+                                   logits_fn=logits_fn)
+
+    return jax.jit(chunk_prefill, donate_argnums=1)
+
+
+def make_paged_chunk_prefill_fn(cfg: GPTConfig, compute_dtype=None,
+                                quantized=False):
+    """jit(paged chunked prefill), cache DONATED (4 alias pairs: pool
+    k/v, lengths, block tables). Int8 pools are refused — see
+    :func:`_paged_chunk_prefill_core`."""
+    embed, dense_fns, logits_fn = _unsharded_fns(cfg, compute_dtype,
+                                                 quantized)
+
+    def chunk_prefill(params, cache, ids, mask, slot, pos, write_pages,
+                      gather_row, store_row):
+        return _paged_chunk_prefill_core(
+            params, cfg, cache, ids, mask, slot, pos, write_pages,
+            gather_row, store_row, embed_fn=embed, dense_fns=dense_fns,
+            logits_fn=logits_fn)
+
+    return jax.jit(chunk_prefill, donate_argnums=1)
 
 
 def make_copy_page_fn():
@@ -905,6 +1062,60 @@ def make_tp_tree_verify_fn(model: GPTModel, mesh=None, quantized=False):
     sharded = ps.shard_map(
         verify, mesh=mesh,
         in_specs=(pspecs, cspecs, P(), P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_chunk_prefill_fn(model: GPTModel, mesh=None, quantized=False):
+    """TP chunked prefill: heads (and the cache head axis) shard over
+    ``model``; slot/pos/mask are replicated host decisions, and the
+    final chunk's (1, V) logits leave through the vocab-sharded head +
+    rank-order gather, exactly as :func:`make_tp_prefill_fn`."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = cache_partition_specs()
+
+    def chunk_prefill(params, cache, ids, mask, slot, pos):
+        return _chunk_prefill_core(params, cfg, cache, ids, mask, slot,
+                                   pos, embed_fn=embed,
+                                   dense_fns=dense_fns,
+                                   logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        chunk_prefill, mesh=mesh,
+        in_specs=(pspecs, cspecs, P(), P(), P(), P()),
+        out_specs=(cspecs, P()))
+    return jax.jit(sharded, donate_argnums=1)
+
+
+def make_tp_paged_chunk_prefill_fn(model: GPTModel, mesh=None,
+                                   quantized=False):
+    """TP paged chunked prefill: page ids and both block-table rows are
+    replicated host decisions, so every rank scatters its local heads'
+    tiles to the same physical pages (int8 pools refused — no
+    ``kv_quantized`` switch, as with tree verify)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    cfg = model.cfg
+    (embed, dense_fns, logits_fn), pspecs = _tp_build(model, quantized)
+    cspecs = paged_cache_partition_specs()
+
+    def chunk_prefill(params, cache, ids, mask, slot, pos, write_pages,
+                      gather_row, store_row):
+        return _paged_chunk_prefill_core(
+            params, cfg, cache, ids, mask, slot, pos, write_pages,
+            gather_row, store_row, embed_fn=embed, dense_fns=dense_fns,
+            logits_fn=logits_fn)
+
+    sharded = ps.shard_map(
+        chunk_prefill, mesh=mesh,
+        in_specs=(pspecs, cspecs, P(), P(), P(), P(), P(), P(), P()),
         out_specs=(cspecs, P()))
     return jax.jit(sharded, donate_argnums=1)
 

@@ -24,6 +24,14 @@ executables stay fault-free):
 ``prefill_exec``   ``prefill`` raises :class:`InjectedFault` before
                    touching the cache (page references are rolled back
                    first) — a simulated transient device failure
+``chunk_prefill_exec``
+                   one prompt CHUNK raises :class:`InjectedFault`
+                   before touching the cache — a mid-prefill device
+                   failure. The scheduler frees the slot (releasing
+                   every held page), charges the retry budget, and
+                   requeues the request at the head; the retried
+                   prefill restarts from the prompt start, so the
+                   recovered stream is bit-identical to golden
 ``decode_exec``    one slot's decode logits row is overwritten with NaN
                    AFTER the jitted step — exercises the scheduler's
                    always-on non-finite quarantine path
@@ -49,8 +57,8 @@ import hashlib
 from typing import Dict, Iterable, Mapping, Optional, Tuple
 
 #: The named fault sites, in the order the docs list them.
-SITES = ("pool_alloc", "cow_clone", "prefill_exec", "decode_exec",
-         "sample", "draft_exec")
+SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
+         "decode_exec", "sample", "draft_exec")
 
 
 class InjectedFault(RuntimeError):

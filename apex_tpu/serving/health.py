@@ -152,6 +152,7 @@ STAT_FIELDS = {
     "draft_faults": "draft_exec faults (degraded ticks)",
     "spec_ticks": "verify-step ticks (linear or tree)",
     "plain_ticks": "single-token decode ticks",
+    "prefill_chunks": "chunked-prefill chunk forwards run",
 }
 
 
@@ -236,7 +237,11 @@ class RequestOutcome:
     ``ttft_ticks`` / ``total_ticks`` are tick-clock latencies stamped
     by the scheduler's tracer bookkeeping: submit -> first committed
     token, and submit -> termination. ``ttft_ticks`` is ``None`` when
-    the request died before emitting anything."""
+    the request died before emitting anything. ``prefill_ticks`` counts
+    the ticks that ran prefill work for the request (1 on the
+    monolithic path; the number of chunk-carrying ticks, across
+    retries, when chunked prefill is on) — ``None`` when the request
+    never reached prefill."""
 
     tokens: Tuple[int, ...]
     reason: str
@@ -244,6 +249,7 @@ class RequestOutcome:
     retries: int = 0
     ttft_ticks: Optional[int] = None
     total_ticks: Optional[int] = None
+    prefill_ticks: Optional[int] = None
 
     @property
     def ok(self) -> bool:

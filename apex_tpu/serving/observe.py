@@ -46,9 +46,11 @@ from collections import deque
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 #: Per-tick phase spans, in tick order. ``exec`` covers the jitted
-#: decode / verify / tree-verify dispatch inside the engine; the rest
-#: are host-side scheduler phases.
-PHASES = ("draft", "prepare_decode", "exec", "accept", "commit")
+#: decode / verify / tree-verify dispatch inside the engine;
+#: ``chunk_prefill`` one jitted prompt-chunk forward (several may run
+#: per tick, one span each); the rest are host-side scheduler phases.
+PHASES = ("draft", "prepare_decode", "exec", "accept", "commit",
+          "chunk_prefill")
 
 #: Per-request lifecycle instants.
 LIFECYCLE = ("submitted", "admitted", "prefill", "first_token",

@@ -15,6 +15,26 @@ Determinism: every sampled token draws from
 request stream regenerates identical outputs regardless of how requests
 interleave across slots.
 
+Chunked prefill (``chunk_tokens=``, the Sarathi-Serve move): a
+monolithic prompt forward stalls every co-tenant decode for the whole
+prompt length, which is exactly what blows up p99 inter-token latency
+under mixed prompt/decode load. With chunking on, admission only
+STAGES a prefill (pages allocated up front, all-or-nothing); each tick
+then runs the decode step first and spends whatever remains of
+``tick_token_budget`` on page-aligned prompt chunks — one jitted
+executable total, every chunk padded to ``chunk_tokens``. Concurrent
+prefills are ordered earliest-deadline-first and round-robined one
+chunk at a time (fair share); at least one chunk always runs so a
+saturated decode batch cannot starve admission. A mid-prefill slot is
+invisible to the decode path, and on the paged cache its block-table
+row stays parked on scratch until the final chunk installs it — the
+garbage row co-tenant ticks write for every slot must never land in a
+shared page. The final chunk yields the same first-token logits
+position as monolithic prefill and samples with the same key, so the
+COMMITTED token streams are bit-identical to the synchronous
+scheduler: chunking only reorders when prompt work happens, never what
+any request observes.
+
 Speculative decoding (``spec_k > 0``): each tick first asks the
 host-side n-gram drafter (``serving.draft``) for up to ``spec_k``
 candidate tokens per slot, then runs ONE verify step over the k+1
@@ -114,7 +134,8 @@ from apex_tpu.serving.cache import (
     init_cache, init_paged_cache, max_pages_per_slot,
 )
 from apex_tpu.serving.decode import (
-    make_copy_page_fn, make_decode_fn, make_paged_decode_fn,
+    make_chunk_prefill_fn, make_copy_page_fn, make_decode_fn,
+    make_paged_chunk_prefill_fn, make_paged_decode_fn,
     make_paged_prefill_fn, make_paged_tree_verify_fn,
     make_paged_verify_fn, make_prefill_fn, make_tree_verify_fn,
     make_verify_fn,
@@ -151,12 +172,25 @@ class Request:
 
 
 @dataclasses.dataclass
+class _PrefillProgress:
+    """In-flight chunked prefill for a slot: the full teacher-forcing
+    sequence being prefilled, the next chunk's start position, and the
+    engine's opaque staging state from ``begin_chunk_prefill`` (page
+    plan, prefix keys). While ``_Slot.prefill`` holds one of these the
+    slot owns cache capacity but is invisible to the decode path."""
+    tokens: Tuple[int, ...]
+    next: int
+    state: Dict
+
+
+@dataclasses.dataclass
 class _Slot:
     request_id: int
     request: Request
     prompt_len: int
     generated: List[int]
     pos: int            # cache rows written (prompt + decode steps)
+    prefill: Optional[_PrefillProgress] = None
 
 
 class DecodeEngine:
@@ -211,6 +245,8 @@ class DecodeEngine:
         quantized = is_quantized_tree(params)
         self.cache = init_cache(cfg, num_slots, max_len, cache_dtype)
         self._prefill = make_prefill_fn(cfg, compute_dtype, quantized)
+        self._chunk_prefill = make_chunk_prefill_fn(cfg, compute_dtype,
+                                                    quantized)
         self._decode = make_decode_fn(cfg, compute_dtype, quantized)
         self._verify = make_verify_fn(cfg, compute_dtype, quantized)
         self._tree_verify = make_tree_verify_fn(
@@ -264,6 +300,51 @@ class DecodeEngine:
         if trc.enabled:
             trc.end("prefill", slot=slot, bucket=int(ids.shape[1]))
         return logits
+
+    # -- chunked prefill ------------------------------------------------
+
+    def begin_chunk_prefill(self, slot: int,
+                            prompt: Sequence[int]) -> Dict:
+        """Stage a chunked prefill of ``prompt`` into ``slot``; returns
+        the opaque per-request state :meth:`chunk_prefill` consumes.
+        The dense cache needs no staging (rows are slot-owned), so the
+        state only carries the chunking start offset."""
+        if len(prompt) > self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds cache max_len "
+                f"{self.max_len}")
+        return {"start": 0}
+
+    def chunk_prefill(self, slot: int, chunk: Sequence[int], pos: int,
+                      state: Dict, bucket: int,
+                      final: bool) -> jax.Array:
+        """Run ONE prompt chunk (rows ``pos .. pos+len(chunk)-1``) for
+        ``slot``; every call pads to ``bucket`` tokens, so exactly one
+        executable exists per chunk size. Returns the chunk's
+        last-real-token logits (1, V) — only the final chunk's feed the
+        first sampled token. An armed ``chunk_prefill_exec`` fault site
+        raises :class:`InjectedFault` BEFORE touching the cache."""
+        fired, _ = self.injector.draw("chunk_prefill_exec")
+        if fired:
+            raise InjectedFault(
+                "chunk_prefill_exec",
+                self.injector.calls("chunk_prefill_exec") - 1)
+        ids = np.asarray(chunk, np.int32)[None, :]
+        ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=(bucket,))
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("chunk_prefill")
+        self.cache, logits = self._chunk_prefill(
+            self.params, self.cache, ids, mask, jnp.int32(slot),
+            jnp.int32(pos))
+        if trc.enabled:
+            trc.end("chunk_prefill", slot=slot, pos=pos, bucket=bucket,
+                    final=final)
+        return logits
+
+    def finish_chunk_prefill(self, slot: int, state: Dict) -> None:
+        """Post-final-chunk bookkeeping (prefix registration on the
+        paged engine); a no-op for the dense cache."""
 
     def decode(self, tokens: jax.Array, active: jax.Array) -> jax.Array:
         """One token for every slot; ``active`` gates length advance.
@@ -539,8 +620,14 @@ class PagedDecodeEngine(DecodeEngine):
         self.pool = PagePool(num_pages, page_size, free_order,
                              injector=self.injector)
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
+        # slots mid-chunked-prefill: their device block-table row is
+        # parked on scratch (see begin_chunk_prefill), so the audit
+        # must not expect it to mirror _slot_pages yet
+        self._prefill_parked: set = set()
         self._prefill = make_paged_prefill_fn(cfg, compute_dtype,
                                               quantized)
+        self._chunk_prefill = make_paged_chunk_prefill_fn(
+            cfg, compute_dtype, quantized)
         self._decode = make_paged_decode_fn(cfg, compute_dtype, quantized)
         self._verify = make_paged_verify_fn(cfg, compute_dtype, quantized)
         self._tree_verify = make_paged_tree_verify_fn(
@@ -622,6 +709,103 @@ class PagedDecodeEngine(DecodeEngine):
             self.pool.register_prefix(keys, pages)
         return logits
 
+    # -- chunked prefill ------------------------------------------------
+
+    def begin_chunk_prefill(self, slot: int,
+                            prompt: Sequence[int]) -> Dict:
+        """Stage a chunked prefill: share the longest cached prefix
+        run and allocate the private pages UP FRONT (all-or-nothing,
+        with the same rollback as :meth:`prefill`), but run no forward
+        yet. While chunks are in flight the slot's device block-table
+        row stays parked on scratch: co-tenant decode/verify ticks
+        write a garbage row for EVERY slot, and a mid-prefill slot's
+        write target could be a SHARED page — parking routes those
+        writes to the scratch page until the final chunk atomically
+        installs the real row. Fully-shared leading pages are skipped
+        (their rows are the original owner's, reused verbatim); the
+        last page always runs so the final chunk yields the
+        first-token logits."""
+        toks = [int(t) for t in prompt]
+        if len(toks) > self.max_len:
+            raise ValueError(
+                f"prompt length {len(toks)} exceeds cache max_len "
+                f"{self.max_len}")
+        n_pages = max_pages_per_slot(len(toks), self.page_size)
+        keys = prefix_page_keys(toks, self.page_size)
+        shared = self.pool.match_prefix(keys) if self.prefix_sharing \
+            else []
+        private: List[int] = []
+        for _ in range(n_pages - len(shared)):
+            p = self.pool.alloc()
+            if p is None:
+                for q in shared + private:
+                    self.pool.release(q)
+                raise PoolExhausted(
+                    f"prompt needs {n_pages} pages; pool has "
+                    f"{self.pool.num_free} free and nothing left to "
+                    "evict", need=n_pages, free=self.pool.num_free,
+                    cached=self.pool.num_cached)
+            private.append(p)
+        pages = shared + private
+        self._slot_pages[slot] = list(pages)
+        self._prefill_parked.add(slot)
+        row = np.full((self.max_pages,), NULL_PAGE, np.int32)
+        row[:n_pages] = pages
+        skip = min(len(shared), max(n_pages - 1, 0))
+        return {"keys": keys, "pages": pages, "shared": len(shared),
+                "n_pages": n_pages, "row": row,
+                "start": skip * self.page_size}
+
+    def chunk_prefill(self, slot: int, chunk: Sequence[int], pos: int,
+                      state: Dict, bucket: int,
+                      final: bool) -> jax.Array:
+        """Run one page-aligned prompt chunk for ``slot``: the chunk's
+        tokens write whole private pages (shared and beyond-prompt
+        pages redirect to scratch) while attention gathers through the
+        real NULL-padded row — earlier chunks' pages AND the shared
+        prefix are visible, later positions are masked out. The final
+        chunk additionally installs the real block-table row (ending
+        the scratch parking, see :meth:`begin_chunk_prefill`). An
+        armed ``chunk_prefill_exec`` site raises
+        :class:`InjectedFault` before touching the cache — the caller
+        frees the slot, which releases every staged page."""
+        fired, _ = self.injector.draw("chunk_prefill_exec")
+        if fired:
+            raise InjectedFault(
+                "chunk_prefill_exec",
+                self.injector.calls("chunk_prefill_exec") - 1)
+        ids = np.asarray(chunk, np.int32)[None, :]
+        ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=(bucket,))
+        first_page = pos // self.page_size
+        write = np.full((bucket // self.page_size,), SCRATCH_PAGE,
+                        np.int32)
+        for j in range(write.shape[0]):
+            ai = first_page + j
+            if state["shared"] <= ai < state["n_pages"]:
+                write[j] = state["pages"][ai]
+        if final:
+            store = state["row"]
+            self._prefill_parked.discard(slot)
+        else:
+            store = np.full((self.max_pages,), SCRATCH_PAGE, np.int32)
+        trc = self.tracer
+        if trc.enabled:
+            trc.begin("chunk_prefill")
+        self.cache, logits = self._chunk_prefill(
+            self.params, self.cache, ids, mask, jnp.int32(slot),
+            jnp.int32(pos), jnp.asarray(write),
+            jnp.asarray(state["row"]), jnp.asarray(store))
+        if trc.enabled:
+            trc.end("chunk_prefill", slot=slot, pos=pos, bucket=bucket,
+                    final=final, shared_pages=state["shared"])
+        return logits
+
+    def finish_chunk_prefill(self, slot: int, state: Dict) -> None:
+        """Register the completed prompt's prefix chain for future
+        admissions — the same registration monolithic prefill does."""
+        if self.prefix_sharing:
+            self.pool.register_prefix(state["keys"], state["pages"])
+
     def prepare_decode(self, positions: Dict[int, int],
                        n_new: int = 1) -> List[int]:
         """Before a tick writes rows ``pos .. pos + n_new - 1`` for each
@@ -690,6 +874,7 @@ class PagedDecodeEngine(DecodeEngine):
         for p in self._slot_pages[slot]:
             self.pool.release(p)
         self._slot_pages[slot] = []
+        self._prefill_parked.discard(slot)
         self.cache = self.cache._replace(
             block_tables=self.cache.block_tables.at[slot].set(
                 jnp.full((self.max_pages,), SCRATCH_PAGE, jnp.int32)))
@@ -704,7 +889,12 @@ class PagedDecodeEngine(DecodeEngine):
         (:func:`~apex_tpu.serving.cache.audit_block_tables`). Raises
         :class:`~apex_tpu.serving.health.PoolInvariantError`."""
         self.pool.check_invariants(self._slot_pages)
-        audit_block_tables(self.cache.block_tables, self._slot_pages)
+        # mid-chunked-prefill slots hold pages but park their device
+        # row on scratch until the final chunk installs it — audit
+        # those rows as empty (all scratch/null) instead
+        expect = [[] if i in self._prefill_parked else p
+                  for i, p in enumerate(self._slot_pages)]
+        audit_block_tables(self.cache.block_tables, expect)
         return True
 
     def pool_snapshot(self) -> Dict:
@@ -727,13 +917,50 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine: DecodeEngine, eos_id: int, *,
                  max_retries: int = 3, max_queue: Optional[int] = None,
-                 watchdog_limit: int = 64, audit: bool = False):
+                 watchdog_limit: int = 64, audit: bool = False,
+                 chunk_tokens: Optional[int] = None,
+                 tick_token_budget: Optional[int] = None):
         self.engine = engine
         self.eos_id = eos_id
         self.max_retries = max_retries
         self.max_queue = max_queue
         self.watchdog_limit = watchdog_limit
         self.audit = audit
+        # chunked prefill: split every admission's prompt forward into
+        # chunk_tokens-sized pieces run BETWEEN decode ticks under a
+        # per-tick token budget (see _prefill_phase). None keeps the
+        # classic monolithic admission prefill.
+        if chunk_tokens is not None:
+            chunk_tokens = int(chunk_tokens)
+            if chunk_tokens < 1:
+                raise ValueError(f"chunk_tokens must be >= 1, got "
+                                 f"{chunk_tokens}")
+            if engine.max_len % chunk_tokens:
+                raise ValueError(
+                    f"chunk_tokens {chunk_tokens} must divide the "
+                    f"cache max_len {engine.max_len} (chunk starts "
+                    "must never overrun the cache row)")
+            if engine.paged and chunk_tokens % engine.page_size:
+                raise ValueError(
+                    f"paged chunks write whole pages: chunk_tokens "
+                    f"{chunk_tokens} is not a multiple of page_size "
+                    f"{engine.page_size}")
+            if getattr(engine.cache, "k_scale", None) is not None:
+                raise ValueError(
+                    "chunked prefill is not offered over the int8 "
+                    "page pool: incremental chunk writes would "
+                    "re-round committed history at chunk-dependent "
+                    "scales; kv8 keeps monolithic prefill")
+        self.chunk_tokens = chunk_tokens
+        if tick_token_budget is not None:
+            tick_token_budget = int(tick_token_budget)
+            if tick_token_budget < 1:
+                raise ValueError(f"tick_token_budget must be >= 1, "
+                                 f"got {tick_token_budget}")
+        elif chunk_tokens is not None:
+            # default: every decode slot's token plus one prefill chunk
+            tick_token_budget = engine.num_slots + chunk_tokens
+        self.tick_token_budget = tick_token_budget
         self.stats = engine.stats  # one counter block per engine
         self.tracer = engine.tracer  # one tracer per engine, like stats
         self.outcomes: Dict[int, RequestOutcome] = {}
@@ -746,8 +973,15 @@ class ContinuousBatchingScheduler:
         # total_ticks and, when tracing, the TTFT/ITL histograms)
         self._first_token_tick: Dict[int, int] = {}
         self._last_token_tick: Dict[int, int] = {}
+        # ticks that ran prefill work per request (feeds
+        # RequestOutcome.prefill_ticks); accumulates across retries
+        self._prefill_ticks: Dict[int, int] = {}
         self._tick_no = 0
         self._tokens_emitted = 0
+        # progress-watchdog state (instance-held so external drivers
+        # can call step() directly, e.g. the Poisson scenario bench)
+        self._stalled = 0
+        self._watch_snap = None
         # (B,) base keys × (B, k1) offsets -> (B, k1, 2) per-position
         # sampling keys for verify ticks: position j of slot b folds in
         # n_generated[b] + j — the plain stream's key for that token
@@ -761,7 +995,25 @@ class ContinuousBatchingScheduler:
         self._accept_ewma = [1.0] * engine.num_slots
         self._probe_every = 16
 
-    def submit(self, request: Request) -> int:
+    @property
+    def clock(self) -> int:
+        """The scheduler's work-charged tick clock (decode-step
+        equivalents): every forward advances it by the sequential
+        depth it covers, so open-loop load generators can pace
+        arrivals against it as a wall-time proxy."""
+        return self._tick_no
+
+    def advance_clock(self, tick: int) -> None:
+        """Fast-forward an idle scheduler's clock to ``tick`` (no-op
+        when already past it): load generators jump over quiet gaps
+        between arrivals instead of spinning empty ticks through the
+        watchdog."""
+        self._tick_no = max(self._tick_no, int(tick))
+        if self.tracer.enabled:
+            self.tracer.set_tick(self._tick_no)
+
+    def submit(self, request: Request,
+               at_tick: Optional[int] = None) -> int:
         if self.max_queue is not None \
                 and len(self._queue) >= self.max_queue:
             self.stats.admission_rejections += 1
@@ -785,7 +1037,15 @@ class ContinuousBatchingScheduler:
             + self.engine.spec_k)
         rid = self._next_id
         self._next_id += 1
-        self._submit_tick[rid] = self._tick_no
+        # ``at_tick`` backdates the arrival for open-loop drivers: a
+        # charged forward can jump the clock PAST a request's true
+        # arrival time before the driver gets to submit it, and the
+        # wait spent behind that forward must still show up in TTFT
+        # (and burn the deadline) — otherwise monolithic prefill hides
+        # exactly the head-of-line blocking the chunked scheduler is
+        # measured against
+        self._submit_tick[rid] = self._tick_no if at_tick is None \
+            else min(int(at_tick), self._tick_no)
         trc = self.tracer
         if trc.enabled:
             trc.instant("submitted", request_id=rid,
@@ -817,7 +1077,25 @@ class ContinuousBatchingScheduler:
         self.outcomes[rid] = RequestOutcome(
             tuple(int(t) for t in tokens), reason, error,
             retries=self._retries.get(rid, 0),
-            ttft_ticks=ttft, total_ticks=total)
+            ttft_ticks=ttft, total_ticks=total,
+            prefill_ticks=self._prefill_ticks.get(rid))
+
+    def _charge_work(self, tokens: int) -> None:
+        """Advance the scheduler clock by a prefill forward's
+        sequential depth. Same decode-step-equivalents rule as the
+        multi-token speculative commit (a tick that commits m tokens
+        counts m): a forward that advances one stream by ``tokens``
+        positions costs that many ticks, so tick-clock TTFT/ITL and
+        deadlines price head-of-line blocking honestly — a monolithic
+        S-token prefill opens an ~S-tick gap in co-tenant streams,
+        while chunked prefill bounds the gap at the tick token
+        budget. Purely an accounting change: sampling keys fold in
+        token counts, never ticks, so committed streams are
+        untouched."""
+        if tokens > 1:
+            self._tick_no += tokens - 1
+            if self.tracer.enabled:
+                self.tracer.set_tick(self._tick_no)
 
     def _note_token(self, rid: int, slot: int) -> None:
         """Per-committed-token tick-clock bookkeeping. The first token
@@ -904,6 +1182,9 @@ class ContinuousBatchingScheduler:
     # -- admission / decode ticks -----------------------------------------
 
     def _admit(self) -> None:
+        if self.chunk_tokens is not None:
+            self._admit_chunked()
+            return
         eng = self.engine
         for i in range(eng.num_slots):
             if self._slots[i] is not None or not self._queue:
@@ -942,6 +1223,9 @@ class ContinuousBatchingScheduler:
                                  self._budget_error(rid, e))
                     continue
                 break
+            self._prefill_ticks[rid] = \
+                self._prefill_ticks.get(rid, 0) + 1
+            self._charge_work(len(tokens))
             first_tok = None
             if not resume:
                 # the FIRST generated token comes from the prefill
@@ -996,6 +1280,149 @@ class ContinuousBatchingScheduler:
                          self._budget_error(rid, err))
             return True
         return False
+
+    def _admit_chunked(self) -> None:
+        """Chunked admission: claim a free slot and STAGE the prefill
+        (pages allocated, no forward run) — the chunks execute in
+        :meth:`_prefill_phase` under the tick token budget, so a long
+        prompt never monopolizes a tick that co-tenant decodes need."""
+        eng = self.engine
+        trc = self.tracer
+        for i in range(eng.num_slots):
+            if self._slots[i] is not None or not self._queue:
+                continue
+            rid, req, resume = self._queue[0]
+            tokens = tuple(req.prompt) + tuple(resume[:-1])
+            try:
+                state = eng.begin_chunk_prefill(i, tokens)
+            except PoolExhausted as e:
+                self.stats.pool_exhausted += 1
+                if all(s is None for s in self._slots) \
+                        and not eng.injector.armed:
+                    err = PoolExhausted(
+                        "page pool cannot admit the queue head even "
+                        f"with every slot free (request {rid}) — "
+                        "submit-time validation should have rejected "
+                        "it", need=e.need, free=e.free,
+                        cached=e.cached)
+                    if trc.enabled:
+                        trc.attach(err)
+                    raise err from e
+                break
+            self._queue.popleft()
+            slot = _Slot(rid, req, len(req.prompt), list(resume),
+                         len(tokens))
+            slot.prefill = _PrefillProgress(
+                tokens=tokens, next=int(state.get("start", 0)),
+                state=state)
+            if trc.enabled:
+                trc.instant("admitted", request_id=rid, slot=i,
+                            resumed=bool(resume), chunked=True)
+            self._slots[i] = slot
+            self._accept_ewma[i] = 1.0
+
+    def _decoding(self, s: Optional[_Slot]) -> bool:
+        """A slot the decode path may touch: occupied AND past its
+        (possibly in-flight chunked) prefill."""
+        return s is not None and s.prefill is None
+
+    def _fail_prefill(self, i: int, err) -> None:
+        """A chunk faulted or the completed prefill's first token was
+        corrupt: free the slot (releasing every staged page), charge
+        the retry budget, and requeue at the FRONT with any committed
+        progress — the retried prefill restarts from the prompt start,
+        so the recovered stream stays bit-identical."""
+        s = self._slots[i]
+        self._slots[i] = None
+        self.engine.free_slot(i)
+        rid = s.request_id
+        if self._charge_retry(rid):
+            self._finish(rid, s.generated, "retry_budget",
+                         self._budget_error(rid, err))
+        else:
+            self._queue.appendleft((rid, s.request, list(s.generated)))
+
+    def _finish_prefill(self, i: int, logits) -> None:
+        """The final chunk just ran: install the slot into the decode
+        set, sampling the first token from the chunk logits with the
+        SAME gates (finiteness, vocab range) and the same key —
+        ``fold_in(seed, 0)`` — the monolithic path uses."""
+        eng = self.engine
+        s = self._slots[i]
+        rid = s.request_id
+        eng.finish_chunk_prefill(i, s.prefill.state)
+        s.prefill = None
+        if not s.generated:
+            if not bool(np.asarray(eng.finite(logits)).all()):
+                self.stats.nan_events += 1
+                self._fail_prefill(i, NonFiniteLogits(
+                    f"request {rid}: non-finite prefill logits"))
+                return
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(s.request.seed), 0)
+            first_tok = int(eng.sample(
+                logits, key[None, :],
+                jnp.asarray([s.request.temperature], jnp.float32))[0])
+            if not 0 <= first_tok < eng.cfg.vocab_size:
+                self.stats.bad_samples += 1
+                self._fail_prefill(i, NonFiniteLogits(
+                    f"request {rid}: first sampled token {first_tok} "
+                    f"outside [0, {eng.cfg.vocab_size})"))
+                return
+            s.generated.append(first_tok)
+            self._tokens_emitted += 1
+            self._note_token(rid, i)
+        self._maybe_evict(i)
+
+    def _prefill_phase(self, spent: int) -> None:
+        """Run prompt chunks with whatever token budget the decode
+        phase left over (always at least one chunk — a saturated decode
+        batch must not starve prefill, or TTFT would be unbounded).
+        Slots are ordered earliest-deadline-first with request id as
+        the deterministic tiebreak, then round-robined one chunk at a
+        time — fair share across concurrent prefills."""
+        if not any(s is not None and s.prefill is not None
+                   for s in self._slots):
+            return
+        eng = self.engine
+        budget = max(self.tick_token_budget - spent, 0)
+        n_chunks = max(budget // self.chunk_tokens, 1)
+
+        def key(i):
+            s = self._slots[i]
+            dl = s.request.deadline_ticks
+            abs_dl = (self._submit_tick.get(s.request_id, 0) + dl
+                      if dl is not None else float("inf"))
+            return (abs_dl, s.request_id)
+
+        order = deque(sorted(
+            (i for i, s in enumerate(self._slots)
+             if s is not None and s.prefill is not None), key=key))
+        progressed = set()
+        while n_chunks > 0 and order:
+            i = order.popleft()
+            s = self._slots[i]
+            p = s.prefill
+            n_chunks -= 1
+            chunk = p.tokens[p.next:p.next + self.chunk_tokens]
+            final = p.next + self.chunk_tokens >= len(p.tokens)
+            try:
+                logits = eng.chunk_prefill(i, chunk, p.next, p.state,
+                                           self.chunk_tokens, final)
+            except InjectedFault as e:
+                self._fail_prefill(i, e)
+                continue
+            self.stats.prefill_chunks += 1
+            progressed.add(s.request_id)
+            self._charge_work(len(chunk))
+            if final:
+                self._finish_prefill(i, logits)
+            else:
+                p.next += self.chunk_tokens
+                order.append(i)
+        for rid in progressed:
+            self._prefill_ticks[rid] = \
+                self._prefill_ticks.get(rid, 0) + 1
 
     def _maybe_evict(self, i: int) -> None:
         slot = self._slots[i]
@@ -1096,18 +1523,29 @@ class ContinuousBatchingScheduler:
         return trees
 
     def _tick(self) -> None:
+        spent = self._decode_phase()
+        if self.chunk_tokens is not None:
+            self._prefill_phase(spent)
+
+    def _decode_phase(self) -> int:
+        """One decode/verify step over every DECODING slot (slots mid
+        chunked-prefill are invisible here — no cache row of theirs is
+        complete). Returns the tick's decode token charge (positions
+        computed), which the prefill phase subtracts from the tick
+        token budget."""
         eng = self.engine
         trc = self.tracer
-        # give every occupied slot an exclusive write target for this
+        # give every decoding slot an exclusive write target for this
         # tick; slots the pool can't serve are preempted back to the
         # queue FRONT with their progress (sampling keys depend only on
         # (seed, n_generated), so a resumed request continues its
         # original stream bit-for-bit)
         positions = {i: s.pos for i, s in enumerate(self._slots)
-                     if s is not None}
+                     if self._decoding(s)}
         if eng.tree_spec and eng.spec_k > 0 and positions:
-            if self._tree_tick(positions):
-                return
+            spent = self._tree_tick(positions)
+            if spent is not None:
+                return spent
             # every forced chain was trivial and no draft survived —
             # fall through to a plain decode step
             drafts, spec, k1 = None, False, 1
@@ -1156,23 +1594,23 @@ class ContinuousBatchingScheduler:
             self._queue.appendleft((s.request_id, s.request,
                                     list(s.generated)))
             self._slots[i] = None
-        occupied = [s for s in self._slots if s is not None]
+        occupied = [s for s in self._slots if self._decoding(s)]
         if not occupied:
-            return
+            return 0
         if spec:
             self._spec_tick(drafts, k1)
-            return
+            return k1 * len(occupied)
         self.stats.plain_ticks += 1
         tokens = jnp.asarray(
-            [s.generated[-1] if s else 0 for s in self._slots],
-            jnp.int32)
-        active = jnp.asarray([s is not None for s in self._slots])
+            [s.generated[-1] if self._decoding(s) else 0
+             for s in self._slots], jnp.int32)
+        active = jnp.asarray([self._decoding(s) for s in self._slots])
         temps = jnp.asarray(
-            [s.request.temperature if s else 0.0 for s in self._slots],
-            jnp.float32)
+            [s.request.temperature if self._decoding(s) else 0.0
+             for s in self._slots], jnp.float32)
         keys = jnp.stack(
-            [self._slot_key(s) if s else jax.random.PRNGKey(0)
-             for s in self._slots])
+            [self._slot_key(s) if self._decoding(s)
+             else jax.random.PRNGKey(0) for s in self._slots])
         logits = eng.decode(tokens, active)
         if trc.enabled:
             trc.begin("accept")
@@ -1184,7 +1622,7 @@ class ContinuousBatchingScheduler:
         vocab = eng.cfg.vocab_size
         quarantined: List[Tuple[int, NonFiniteLogits]] = []
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if not self._decoding(slot):
                 continue
             if not bool(finite[i]):
                 self.stats.nan_events += 1
@@ -1213,6 +1651,7 @@ class ContinuousBatchingScheduler:
                 key=lambda t: self._slots[t[0]].request_id,
                 reverse=True):
             self._quarantine(i, err)
+        return len(occupied)
 
     def _spec_tick(self, drafts: List[List[int]], k1: int) -> None:
         """Draft → verify → accept: one verify step over ``k1``
@@ -1231,18 +1670,18 @@ class ContinuousBatchingScheduler:
         rows = []
         for i, s in enumerate(self._slots):
             d = drafts[i][:k1 - 1]
-            rows.append(([s.generated[-1] if s else 0] + d
-                         + [0] * (k1 - 1 - len(d))))
+            rows.append(([s.generated[-1] if self._decoding(s) else 0]
+                         + d + [0] * (k1 - 1 - len(d))))
         tokens = jnp.asarray(rows, jnp.int32)
         temps = jnp.asarray(
-            [s.request.temperature if s else 0.0 for s in self._slots],
-            jnp.float32)
+            [s.request.temperature if self._decoding(s) else 0.0
+             for s in self._slots], jnp.float32)
         base = jnp.stack(
-            [jax.random.PRNGKey(s.request.seed) if s
+            [jax.random.PRNGKey(s.request.seed) if self._decoding(s)
              else jax.random.PRNGKey(0) for s in self._slots])
         offs = jnp.asarray(
-            [[(len(s.generated) if s else 0) + j for j in range(k1)]
-             for s in self._slots], jnp.int32)
+            [[(len(s.generated) if self._decoding(s) else 0) + j
+              for j in range(k1)] for s in self._slots], jnp.int32)
         keys = self._fold_grid(base, offs)
         logits = eng.verify(tokens)
         if trc.enabled:
@@ -1253,7 +1692,7 @@ class ContinuousBatchingScheduler:
         counts = [0] * eng.num_slots
         quarantined: List[Tuple[int, NonFiniteLogits]] = []
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if not self._decoding(slot):
                 continue
             draft = drafts[i]
             committed = accepted = 0
@@ -1319,7 +1758,7 @@ class ContinuousBatchingScheduler:
                 reverse=True):
             self._quarantine(i, err)
 
-    def _tree_tick(self, positions: Dict[int, int]) -> bool:
+    def _tree_tick(self, positions: Dict[int, int]) -> Optional[int]:
         """Tree-speculative tick: pack every slot's FORCED chain (the
         committed tokens past its cache length — at least the pending
         token) plus its draft tree into one tree-attention verify grid,
@@ -1330,9 +1769,11 @@ class ContinuousBatchingScheduler:
         prefix: tokens a path stranded off the leftmost chain are
         re-sent as next tick's forced chain (the forced-prefix rule —
         bounded by the tree depth, never compounding; see
-        ``serving.decode``). Returns False — tick not taken — when
-        every forced chain is trivial and no draft survived, so the
-        caller runs the plain path instead."""
+        ``serving.decode``). Returns the tick's token charge (grid
+        positions computed), 0 when every slot was preempted before
+        the verify, or None — tick not taken — when every forced chain
+        is trivial and no draft survived, so the caller runs the plain
+        path instead."""
         eng = self.engine
         trc = self.tracer
         ks = self._spec_ks(positions)
@@ -1345,12 +1786,12 @@ class ContinuousBatchingScheduler:
                                  if t is not None))
         forced: Dict[int, List[int]] = {}
         for i, s in enumerate(self._slots):
-            if s is not None:
+            if self._decoding(s):
                 h = list(s.request.prompt) + list(s.generated)
                 forced[i] = h[s.pos:]        # f >= 1: the pending token
         if all(len(f) == 1 for f in forced.values()) \
                 and not any(trees[i] is not None for i in positions):
-            return False
+            return None
         # grid width: the widest forced-chain + tree, clamped to the
         # scarcest slot's cache headroom (a slot whose chain overflows
         # the clamped grid catches up across ticks, committing rows
@@ -1377,11 +1818,11 @@ class ContinuousBatchingScheduler:
             self._slots[i] = None
             forced.pop(i, None)
         if not forced:
-            return True
+            return 0
         f_chain: List[List[int]] = []
         g_trees: List[Optional[Tuple[List[int], List[int]]]] = []
         for i, s in enumerate(self._slots):
-            if s is None:
+            if not self._decoding(s):
                 f_chain.append([0])
                 g_trees.append(None)
                 continue
@@ -1400,10 +1841,10 @@ class ContinuousBatchingScheduler:
         tok_np, dep_np, anc_np, val_np, par_np, start_np = tree_arrays(
             f_chain, g_trees, k1)
         temps = jnp.asarray(
-            [s.request.temperature if s else 0.0 for s in self._slots],
-            jnp.float32)
+            [s.request.temperature if self._decoding(s) else 0.0
+             for s in self._slots], jnp.float32)
         base = jnp.stack(
-            [jax.random.PRNGKey(s.request.seed) if s
+            [jax.random.PRNGKey(s.request.seed) if self._decoding(s)
              else jax.random.PRNGKey(0) for s in self._slots])
         # column j samples the (n_generated - f + 1 + depth[j])-th
         # generated token — exactly the plain stream's key offset for
@@ -1411,7 +1852,7 @@ class ContinuousBatchingScheduler:
         # already-committed offsets; their samples are never read)
         offs = np.zeros((eng.num_slots, k1), np.int32)
         for i, s in enumerate(self._slots):
-            if s is not None:
+            if self._decoding(s):
                 offs[i] = (len(s.generated) - len(f_chain[i]) + 1
                            + dep_np[i])
         keys = self._fold_grid(base, jnp.asarray(offs))
@@ -1431,7 +1872,7 @@ class ContinuousBatchingScheduler:
         new_tok_max = 0
         quarantined: List[Tuple[int, NonFiniteLogits]] = []
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if not self._decoding(slot):
                 continue
             f = len(f_chain[i])
             if f < len(forced[i]):
@@ -1504,7 +1945,7 @@ class ContinuousBatchingScheduler:
                 key=lambda t: self._slots[t[0]].request_id,
                 reverse=True):
             self._quarantine(i, err)
-        return True
+        return k1 * len(forced)
 
     # -- drive loop --------------------------------------------------------
 
@@ -1523,6 +1964,44 @@ class ContinuousBatchingScheduler:
             self.tracer.attach(err)  # the stuck slots' last events
         raise err
 
+    @property
+    def busy(self) -> bool:
+        """Work pending: queued requests or occupied slots."""
+        return bool(self._queue) or any(s is not None
+                                        for s in self._slots)
+
+    def step(self) -> None:
+        """One scheduler tick: expire deadlines, admit, decode (and,
+        when chunked prefill is on, run prompt chunks with the budget
+        the decode phase left). Public so external load generators —
+        the Poisson scenario bench — can interleave ``submit`` calls
+        with ticks; :meth:`run` is just the drain loop over this. The
+        progress watchdog spans steps: a chunk forward counts as
+        progress (a long prompt prefilling is converging), so its
+        counter joins tokens/completions/retries in the snapshot."""
+        trc = self.tracer
+        self._tick_no += 1
+        if trc.enabled:
+            trc.set_tick(self._tick_no)
+        before = self._tokens_emitted
+        self._expire_deadlines()
+        self._admit()
+        self._tick()
+        if trc.enabled:
+            trc.tick_metrics(self._tokens_emitted - before,
+                             len(self._queue),
+                             self.engine.pool_gauges())
+        if self.audit:
+            self.engine.check_invariants()
+        snap = (self._tokens_emitted, len(self.outcomes),
+                self.stats.retries, self.stats.prefill_chunks)
+        if snap == self._watch_snap:
+            self._stalled += 1
+            if self._stalled >= self.watchdog_limit:
+                self._raise_livelock(self._stalled)
+        else:
+            self._stalled, self._watch_snap = 0, snap
+
     def run(self) -> List[List[int]]:
         """Drain the queue; returns generated tokens (EOS included when
         emitted) per request, in submission order. Typed outcomes —
@@ -1530,29 +2009,7 @@ class ContinuousBatchingScheduler:
         of their fault-free streams — live in ``self.outcomes``. Raises
         :class:`LivelockError` after ``watchdog_limit`` consecutive
         ticks without progress instead of spinning."""
-        stalled, last = 0, None
-        trc = self.tracer
-        while self._queue or any(s is not None for s in self._slots):
-            self._tick_no += 1
-            if trc.enabled:
-                trc.set_tick(self._tick_no)
-            before = self._tokens_emitted
-            self._expire_deadlines()
-            self._admit()
-            self._tick()
-            if trc.enabled:
-                trc.tick_metrics(self._tokens_emitted - before,
-                                 len(self._queue),
-                                 self.engine.pool_gauges())
-            if self.audit:
-                self.engine.check_invariants()
-            snap = (self._tokens_emitted, len(self.outcomes),
-                    self.stats.retries)
-            if snap == last:
-                stalled += 1
-                if stalled >= self.watchdog_limit:
-                    self._raise_livelock(stalled)
-            else:
-                stalled, last = 0, snap
+        while self.busy:
+            self.step()
         return [list(self.outcomes[rid].tokens)
                 for rid in sorted(self.outcomes)]

@@ -689,6 +689,139 @@ def _observed_decode_probe():
     return trc.latency_summary()
 
 
+_SCENARIO_SEED = {"chat": 101, "batch_completion": 102,
+                  "long_context": 103, "shared_prefix": 104}
+
+
+def _scenario_arrivals(name, vocab):
+    """Seeded-Poisson arrival schedule for one workload mix: a list of
+    ``(tick, Request)`` sorted by arrival tick. Inter-arrival gaps are
+    Poisson draws from a fixed ``numpy`` generator (seeds in
+    ``_SCENARIO_SEED``, one per mix — documented in benchmarking.rst),
+    so every run replays the identical workload: chat (short prompts,
+    steady trickle), batch-completion (one burst at t=0), long-context
+    (40-56-token prompts landing amid short chats — the head-of-line
+    case chunked prefill exists for) and shared-prefix (a common
+    16-token, page-aligned prefix the paged engine's prefix cache can
+    serve)."""
+    import numpy as np
+    from apex_tpu.serving import Request
+
+    rng = np.random.default_rng(_SCENARIO_SEED[name])
+
+    def tok(n):
+        return tuple(int(t) for t in rng.integers(0, vocab, n))
+
+    # arrival ticks are on the scheduler's WORK-CHARGED clock (one
+    # tick ~ one token of sequential depth), so gap means are sized
+    # against per-request service time (prompt + new tokens), not
+    # against host steps
+    out, t = [], 0
+    if name == "chat":
+        for _ in range(8):
+            t += int(rng.poisson(12.0))
+            out.append((t, Request(prompt=tok(int(rng.integers(4, 13))),
+                                   max_new_tokens=int(
+                                       rng.integers(4, 9)))))
+    elif name == "batch_completion":
+        for _ in range(6):
+            out.append((0, Request(prompt=tok(int(rng.integers(8, 17))),
+                                   max_new_tokens=8)))
+    elif name == "long_context":
+        for j in range(6):
+            t += int(rng.poisson(16.0))
+            n = int(rng.integers(40, 57)) if j % 3 == 1 \
+                else int(rng.integers(4, 9))
+            out.append((t, Request(prompt=tok(n), max_new_tokens=4)))
+    elif name == "shared_prefix":
+        prefix = tok(16)
+        for _ in range(8):
+            t += int(rng.poisson(6.0))
+            out.append((t, Request(
+                prompt=prefix + tok(int(rng.integers(2, 7))),
+                max_new_tokens=4)))
+    else:
+        raise ValueError(f"unknown scenario {name!r}")
+    return out
+
+
+def _drive_poisson(sched, arrivals):
+    """Interleave the arrival schedule with public ``step()`` ticks —
+    the open-loop load generator the scheduler's instance-held
+    watchdog state exists for. Arrivals are paced against the
+    scheduler's work-charged ``clock`` (decode-step equivalents, the
+    wall-time proxy) and submitted with ``at_tick=`` backdating, so a
+    request that "arrives" while a charged forward is in flight still
+    measures the wait it spent behind that forward. Returns the
+    committed streams in submission order."""
+    i = 0
+    while i < len(arrivals) or sched.busy:
+        while i < len(arrivals) and arrivals[i][0] <= sched.clock:
+            t, req = arrivals[i]
+            sched.submit(req, at_tick=t)
+            i += 1
+        if sched.busy:
+            sched.step()
+        elif i < len(arrivals):
+            sched.advance_clock(arrivals[i][0])
+    return [list(sched.outcomes[rid].tokens)
+            for rid in sorted(sched.outcomes)]
+
+
+def bench_gpt_serving_scenarios(on_tpu):
+    """Driver config ``gpt_serving_scenarios``: the seeded-Poisson
+    workload mixes replayed through the chunked-prefill scheduler, one
+    line per mix with registry-derived p50/p95/p99 TTFT and ITL in
+    scheduler ticks. The tick clock charges every forward its
+    sequential depth (decode-step equivalents), so these percentiles
+    move only when scheduling POLICY moves — host noise and relay
+    drift cannot touch them. This config tracks the p99-ITL bound the
+    chunked scheduler exists to hold."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  PagedDecodeEngine, Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    names = ("chat", "batch_completion", "long_context",
+             "shared_prefix")
+    # APEX_BENCH_SCENARIOS=chat[,mix...] narrows the sweep — the
+    # run_tests.sh quick tier smokes a single mix this way
+    only = os.environ.get("APEX_BENCH_SCENARIOS")
+    if only:
+        names = tuple(n for n in names if n in only.split(","))
+    for name in names:
+        metric = f"gpt_serving_{name}_itl_p99_ticks"
+        try:
+            trc = Tracer()
+            # fresh engine per mix: the latency histograms live on the
+            # tracer's registry and must not bleed across scenarios
+            eng = PagedDecodeEngine(params, cfg, num_slots=2,
+                                    max_len=64, num_pages=48,
+                                    page_size=4, buckets=(16, 64),
+                                    tracer=trc)
+            sched = ContinuousBatchingScheduler(eng, eos_id=-1,
+                                                chunk_tokens=8)
+            arrivals = _scenario_arrivals(name, cfg.vocab_size)
+            streams = _drive_poisson(sched, arrivals)
+            lat = trc.latency_summary()
+            extra = {"seed": _SCENARIO_SEED[name],
+                     "requests": len(arrivals),
+                     "tokens": sum(len(s) for s in streams),
+                     "prefill_chunks": sched.stats.prefill_chunks,
+                     "chunk_tokens": 8,
+                     "tick_token_budget": sched.tick_token_budget}
+            extra.update(lat)
+            _maybe_dump_trace(trc, f"scenario_{name}")
+            emit(metric, lat.get("itl_p99", 0.0), "ticks", extra=extra,
+                 higher_is_better=False)
+        except Exception as e:  # one mix must never sink the others
+            print(json.dumps({"metric": metric,
+                              "error": repr(e)[:200]}), flush=True)
+
+
 def _spec_decode_setup(on_tpu, spec_k, tracer=None):
     """Scheduler-driven decode over repetitive prompts (the n-gram
     drafter's home turf). Returns ``run() -> (tokens, stats)``: each
@@ -1045,6 +1178,50 @@ def _observed_vs_bare_decode_ab_pair(on_tpu):
         return sample
 
     return side(True), side(False)
+
+
+def _chunked_vs_monolithic_ab_pair(on_tpu):
+    """(side_a, side_b): the chunked-prefill scheduler vs monolithic
+    admission on the same seeded long-context Poisson mix (40-56-token
+    prompts landing mid-decode — the head-of-line case), scored as P99
+    INTER-TOKEN LATENCY IN SCHEDULER TICKS instead of wall seconds.
+    The tick clock charges every forward its sequential depth, so a
+    monolithic S-token prefill opens an ~S-tick gap in co-tenant
+    streams while chunks bound the gap at the tick token budget; the
+    committed streams are asserted bit-identical between the sides
+    before either number is trusted — latency is the ONLY axis this
+    pair is allowed to move. Both sides replay identical arrivals, so
+    each sample is an exact replica and the band collapses to the
+    point ratio. Ratio < 1 = chunking holds the bound."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  PagedDecodeEngine, Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+
+    def side(chunk_tokens):
+        trc = Tracer()
+        eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=64,
+                                num_pages=48, page_size=4,
+                                buckets=(16, 64), tracer=trc)
+        sched = ContinuousBatchingScheduler(eng, eos_id=-1,
+                                            chunk_tokens=chunk_tokens)
+        streams = _drive_poisson(
+            sched, _scenario_arrivals("long_context", cfg.vocab_size))
+        lat = trc.latency_summary()
+        return streams, lat, (lambda: float(lat["itl_p99"]))
+
+    streams_a, lat_a, sample_a = side(8)
+    streams_b, lat_b, sample_b = side(None)
+    assert streams_a == streams_b, "chunked streams diverged"
+    # deferring prompt work costs some TTFT; the contract is that the
+    # cost stays bounded while the ITL tail collapses
+    assert lat_a["ttft_p50"] <= 2.0 * lat_b["ttft_p50"] + 1.0, \
+        (lat_a["ttft_p50"], lat_b["ttft_p50"])
+    return sample_a, sample_b
 
 
 def _decode_cache_ab_pair(on_tpu):
@@ -1618,6 +1795,9 @@ AB_PAIRS = {
     "decode_observed_vs_bare": (
         "trace_on", "noop_hooks",
         _observed_vs_bare_decode_ab_pair),
+    "prefill_chunked_vs_monolithic": (
+        "chunked_budget", "monolithic",
+        _chunked_vs_monolithic_ab_pair),
     "decode_w8_vs_bf16": (
         "w8_weights", "bf16_weights",
         _w8_decode_ab_pair),
@@ -2080,6 +2260,7 @@ CONFIGS = {
     "headline": bench_headline,
     "gpt_decode": bench_gpt_decode,
     "gpt_spec_natural": bench_gpt_spec_natural,
+    "gpt_serving_scenarios": bench_gpt_serving_scenarios,
 }
 
 # Driver execution order (round-4 postmortem). The HEADLINE runs FIRST:
@@ -2090,9 +2271,10 @@ CONFIGS = {
 # r4's 27x seq2048 anomaly, which followed two GPT OOMs). The headline
 # line is RE-EMITTED at the very end so the driver's parse-the-tail
 # convention still lands on the contract metric.
-ORDER = ["headline", "gpt_decode", "gpt_spec_natural", "kernel_parity",
-         "flash_attention", "ab_kernels", "layer_norm", "opt_adam",
-         "opt_lamb", "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
+ORDER = ["headline", "gpt_decode", "gpt_spec_natural",
+         "gpt_serving_scenarios", "kernel_parity", "flash_attention",
+         "ab_kernels", "layer_norm", "opt_adam", "opt_lamb",
+         "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
 
 # Global wall budget (seconds) with per-config caps: the driver must see
 # a finished run. Generous-but-bounded; BENCH_BUDGET_S overrides. Cap
@@ -2103,7 +2285,8 @@ ORDER = ["headline", "gpt_decode", "gpt_spec_natural", "kernel_parity",
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2700"))
 CAP_S = {"headline": 600, "kernel_parity": 480, "ddp_bert": 540,
          "tp_gpt": 600, "flash_attention": 540, "ab_kernels": 540,
-         "gpt_decode": 420, "gpt_spec_natural": 420}
+         "gpt_decode": 420, "gpt_spec_natural": 420,
+         "gpt_serving_scenarios": 420}
 DEFAULT_CAP_S = 480
 
 

@@ -47,7 +47,14 @@ case "$tier" in
   L0)    exec python -m pytest tests/L0 -q "$@" ;;
   L1)    exec python -m pytest tests/L1 -q "$@" ;;
   all)   exec python -m pytest tests -q "$@" ;;
-  quick) exec python -m pytest tests -q -m quick "$@" ;;
+  quick) # the -m quick subset, then a few-arrival smoke of the
+         # seeded-Poisson serving bench (tiny model, chat mix only via
+         # APEX_BENCH_SCENARIOS) so scheduler-policy regressions
+         # surface in the inner loop, not first in CI
+         python -m pytest tests -q -m quick "$@"
+         echo "quick: Poisson serving-bench smoke (chat mix)" >&2
+         exec env APEX_BENCH_SCENARIOS=chat python bench.py \
+             gpt_serving_scenarios ;;
   chaos) # per-seed trace dumps land next to this path (a tag + seed
          # suffix is spliced in before the extension); set it empty to
          # disable the dump

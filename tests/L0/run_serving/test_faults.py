@@ -481,6 +481,98 @@ def test_multi_fault_chaos_is_typed_prefixed_and_replayable(model, seed):
     # the deterministic tick-clock trace stream replays byte-exactly
     assert replay.engine.tracer.tick_stream() \
         == sched.engine.tracer.tick_stream()
+
+
+# -- chunked prefill under faults --------------------------------------------
+
+_CHUNKY = (7, 11, 13, 17, 19, 23, 29, 31, 37, 41)   # 10 tokens, 3 chunks
+
+
+def test_chunk_prefill_fault_mid_prompt_requeues_clean(model):
+    """A fault on the SECOND chunk of a staged prefill frees the slot
+    with zero leaked pages or refcounts (audit runs every tick), charges
+    one retry, and the retried request — restarted from the prompt
+    head — commits a stream bit-identical to the fault-free golden."""
+    reqs = [Request(prompt=_CHUNKY, max_new_tokens=4)]
+    golden = _golden(model, reqs)
+    eng = _engine(model,
+                  FaultInjector(schedule={"chunk_prefill_exec": (1,)}))
+    sched, outs = _drive(eng, reqs, audit=True, chunk_tokens=4)
+    assert outs == golden
+    assert sched.stats.retries == 1
+    assert sched.outcomes[0].ok and sched.outcomes[0].retries == 1
+    # nothing left behind: no slot holds pages, books balance
+    eng.check_invariants()
+    assert all(not pages for pages in eng._slot_pages)
+
+
+def test_chunk_prefill_fault_on_final_chunk_recovers(model):
+    """Same contract when the FINAL chunk faults — the chunk whose
+    logits feed the first token. The staged progress is discarded whole
+    and the retry is still bit-identical."""
+    reqs = [Request(prompt=_CHUNKY, max_new_tokens=4,
+                    temperature=0.8, seed=5)]
+    golden = _golden(model, reqs)
+    eng = _engine(model,
+                  FaultInjector(schedule={"chunk_prefill_exec": (2,)}))
+    sched, outs = _drive(eng, reqs, audit=True, chunk_tokens=4)
+    assert outs == golden
+    assert sched.stats.retries == 1
+    assert sched.outcomes[0].ok
+    eng.check_invariants()
+    assert all(not pages for pages in eng._slot_pages)
+
+
+def test_chunk_prefill_fault_exhausts_retry_budget_typed(model):
+    """A persistently faulting chunk terminates typed with an empty
+    stream — staged chunks never commit tokens — and leaks nothing."""
+    reqs = [Request(prompt=_CHUNKY, max_new_tokens=4)]
+    eng = _engine(model,
+                  FaultInjector(schedule={"chunk_prefill_exec":
+                                          range(20)}))
+    sched, outs = _drive(eng, reqs, audit=True, chunk_tokens=4,
+                         max_retries=2)
+    assert outs == [[]]
+    out = sched.outcomes[0]
+    assert out.reason == "retry_budget" and not out.ok
+    assert isinstance(out.error, RetryBudgetExhausted)
+    eng.check_invariants()
+    assert all(not pages for pages in eng._slot_pages)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chunked_multi_fault_chaos_is_typed_prefixed_and_replayable(
+        model, seed):
+    """The randomized sweep with chunked prefill on and the
+    chunk_prefill_exec site armed: typed outcomes, golden-prefix
+    degradation against the SYNCHRONOUS golden, bit-for-bit replay."""
+    reqs = [Request(prompt=_CHUNKY, max_new_tokens=5),
+            Request(prompt=_CHUNKY[:7], max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=(5, 3), max_new_tokens=4),
+            Request(prompt=_CHUNKY + (43, 47), max_new_tokens=4,
+                    temperature=0.7, seed=9)]
+    golden = _golden(model, reqs)
+    rates = {"pool_alloc": 0.1, "cow_clone": 0.2,
+             "chunk_prefill_exec": 0.2, "decode_exec": 0.1,
+             "sample": 0.1}
+
+    def chaos_run():
+        eng = _engine(model, FaultInjector(seed=seed, rates=rates),
+                      num_pages=14)
+        sched, _ = _drive(eng, reqs, audit=True, chunk_tokens=4)
+        return sched
+
+    sched = chaos_run()
+    _check_contract(sched, reqs, golden)
+    assert sched.engine.injector.counts["chunk_prefill_exec"] > 0 \
+        or sched.stats.prefill_chunks > 0
+    replay = chaos_run()
+    assert replay.outcomes == sched.outcomes
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts
+    assert replay.engine.tracer.tick_stream() \
+        == sched.engine.tracer.tick_stream()
     # CI post-mortem artifact: run_tests.sh chaos points this env var
     # at a tmp path and the workflow uploads the dumps
     out = os.environ.get("APEX_CHAOS_TRACE_OUT")

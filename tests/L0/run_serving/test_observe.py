@@ -241,6 +241,64 @@ def test_request_outcome_carries_tick_latencies(model):
                for o in sched2.outcomes.values())
 
 
+def _drive_chunked(engine, chunk_tokens=4, n_reqs=3, max_new=6):
+    """_drive with chunked prefill on and prompts long enough that
+    every admission really splits into several chunks."""
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS, audit=True,
+                                        chunk_tokens=chunk_tokens)
+    for s in range(n_reqs):
+        sched.submit(Request(
+            prompt=(7, 11, 13 + s, 17, 19, 23, 29 + s, 31, 37, 41),
+            max_new_tokens=max_new, temperature=0.7, seed=s))
+    return sched, sched.run()
+
+
+@pytest_chaos
+def test_chunked_tick_stream_is_replay_exact_under_pinned_faults(model):
+    """The replay contract holds with chunked prefill on and the
+    chunk_prefill_exec site armed: byte-identical tick-clock event
+    streams across two runs at the same seed."""
+    rates = {"cow_clone": 0.2, "chunk_prefill_exec": 0.2,
+             "decode_exec": 0.1, "sample": 0.1}
+
+    def go():
+        trc = Tracer()
+        _drive_chunked(_engine(model, tracer=trc,
+                               injector=FaultInjector(seed=5,
+                                                      rates=rates),
+                               num_pages=12))
+        return trc
+
+    a, b = go(), go()
+    assert a.tick_stream() == b.tick_stream()
+    assert any(e.name == "chunk_prefill" for e in a.events)
+    walls_a = [e.wall for e in a.events]
+    walls_b = [e.wall for e in b.events]
+    assert walls_a != walls_b  # wall clock stays outside the key
+
+
+@pytest_chaos
+def test_chunked_taxonomy_counters_and_outcomes(model):
+    """Chunked runs stay inside the event taxonomy (chunk_prefill is a
+    named phase), the chunk counter is a registry view of the stats
+    block, and outcomes report how many ticks their prefill spanned."""
+    trc = Tracer()
+    sched, chunked = _drive_chunked(_engine(model, tracer=trc))
+    names = {e.name for e in trc.events}
+    assert "chunk_prefill" in names
+    assert names <= set(PHASES) | set(LIFECYCLE)
+    # 3 requests x 10-token prompts in 4-token chunks: 3 chunks each
+    assert sched.stats.prefill_chunks == 9
+    assert trc.registry.counter("serving_prefill_chunks_total").value \
+        == sched.stats.prefill_chunks
+    for out in sched.outcomes.values():
+        assert out.prefill_ticks >= 2   # the prefill really spanned ticks
+        assert out.ttft_ticks is not None and out.ttft_ticks >= 1
+    # and tracing never perturbed the chunked streams
+    _, bare = _drive_chunked(_engine(model))
+    assert chunked == bare
+
+
 @pytest_chaos
 def test_livelock_error_carries_flight_recorder_ring(model):
     """The watchdog's LivelockError payload must include the stuck

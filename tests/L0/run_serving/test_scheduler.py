@@ -565,3 +565,206 @@ def test_spec_config_validation():
         PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
                           num_pages=24, page_size=4, spec_k=2,
                           tree_spec=True, cache_dtype=jnp.int8)
+
+
+# -- chunked prefill ---------------------------------------------------------
+#
+# Same invariance contract as speculation: ``chunk_tokens=`` only moves
+# WHEN prompt work runs (between decode ticks, under the tick token
+# budget), never which tokens any stream commits. Every comparison is
+# exact integer equality against the synchronous (monolithic-admission)
+# scheduler.
+
+
+def _chunky_requests():
+    """_mixed_requests stretched: prompts long enough that
+    chunk_tokens in {4, 8} actually splits them, with a shared prefix
+    pair and mixed greedy/sampled."""
+    base = (7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47)
+    return [Request(prompt=base, max_new_tokens=5),
+            Request(prompt=base[:9], max_new_tokens=5,
+                    temperature=0.8, seed=3),
+            Request(prompt=base + (53, 59, 61), max_new_tokens=4),
+            Request(prompt=(5, 3), max_new_tokens=5,
+                    temperature=0.7, seed=9)]
+
+
+def _run_chunked(params, cfg, requests, num_slots, chunk_tokens,
+                 paged=False, num_pages=24, spec_k=0,
+                 tick_token_budget=None):
+    # fp32 cache on BOTH sides of every comparison: the identity
+    # contract is "chunking moves when prompt work runs, never the
+    # math" — at bf16 the cache itself rounds K/V, so a monolithic
+    # forward (unrounded in-forward activations) and a chunked one
+    # (re-read rounded cache) can legitimately differ in the last bit.
+    import jax.numpy as jnp
+
+    if paged:
+        engine = PagedDecodeEngine(params, cfg, num_slots=num_slots,
+                                   max_len=MAX_LEN, num_pages=num_pages,
+                                   page_size=4, buckets=(16, 32),
+                                   spec_k=spec_k,
+                                   cache_dtype=jnp.float32)
+    else:
+        engine = DecodeEngine(params, cfg, num_slots=num_slots,
+                              max_len=MAX_LEN,
+                              cache_dtype=jnp.float32)
+    sched = ContinuousBatchingScheduler(
+        engine, eos_id=EOS, audit=paged, chunk_tokens=chunk_tokens,
+        tick_token_budget=tick_token_budget)
+    for r in requests:
+        sched.submit(r)
+    return sched.run(), sched
+
+
+@pytest.mark.parametrize("chunk_tokens", [4, 8])
+def test_chunked_streams_match_sync_dense(chunk_tokens):
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _chunky_requests()
+    want, _ = _run_chunked(params, cfg, reqs, 2, None)  # sync golden
+    got, sched = _run_chunked(params, cfg, reqs, 2, chunk_tokens)
+    assert got == want
+    # the prompts really were split, not admitted in one piece
+    assert sched.stats.prefill_chunks > len(reqs)
+
+
+@pytest.mark.parametrize("chunk_tokens", [4, 8])
+def test_chunked_streams_match_sync_paged(chunk_tokens):
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _chunky_requests()
+    want, _ = _run_chunked(params, cfg, reqs, 2, None, paged=True)
+    got, sched = _run_chunked(params, cfg, reqs, 2, chunk_tokens,
+                              paged=True)
+    assert got == want
+    assert sched.stats.prefill_chunks > len(reqs)
+
+
+def test_chunked_streams_invariant_to_tick_token_budget():
+    """The budget only throttles how many chunks share a tick — a huge
+    budget (whole prompts per tick) and the tight default must commit
+    the same tokens."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = _chunky_requests()
+    tight, _ = _run_chunked(params, cfg, reqs, 2, 4, paged=True)
+    wide, _ = _run_chunked(params, cfg, reqs, 2, 4, paged=True,
+                           tick_token_budget=64)
+    assert tight == wide
+
+
+def test_chunked_spec_streams_match_plain_sync():
+    """Chunked prefill composes with speculative decode: the chunked +
+    speculating scheduler still matches the plain synchronous one
+    token-for-token (spec == plain and chunked == sync, composed)."""
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(7, 11, 7, 11, 7, 11, 7, 11, 7, 11),
+                    max_new_tokens=6),
+            Request(prompt=(5, 3, 5, 3, 5, 3, 5, 3), max_new_tokens=6,
+                    temperature=0.8, seed=3)]
+    want, _ = _run_chunked(params, cfg, reqs, 2, None, paged=True)
+    got, sched = _run_chunked(params, cfg, reqs, 2, 4, paged=True,
+                              spec_k=3)
+    assert got == want
+    assert sched.stats.prefill_chunks > len(reqs)
+    assert sched.stats.tokens_drafted > 0  # speculation really ran
+
+
+def test_chunked_final_logits_match_one_shot_paged():
+    """Engine-level contract: the final chunk's last-token logits are
+    BITWISE equal to a one-shot prefill of the same prompt — same
+    jitted executable family, same padded math, no chunk-count drift."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    cfg = _cfg()
+    params = _params(cfg)
+    prompt = tuple(range(2, 2 + 13))    # 13 tokens -> 4 chunks of 4
+
+    def engine():
+        # fp32 cache for the same reason as _run_chunked: bitwise is
+        # only promised where the cache itself doesn't round
+        return PagedDecodeEngine(params, cfg, num_slots=1,
+                                 max_len=MAX_LEN, num_pages=24,
+                                 page_size=4, buckets=(16, 32),
+                                 cache_dtype=jnp.float32)
+
+    one_shot = np.asarray(engine().prefill(0, prompt))
+    eng = engine()
+    state = eng.begin_chunk_prefill(0, prompt)
+    pos, ct = int(state.get("start", 0)), 4
+    while True:
+        chunk = prompt[pos:pos + ct]
+        final = pos + ct >= len(prompt)
+        logits = eng.chunk_prefill(0, chunk, pos, state, ct, final)
+        if final:
+            break
+        pos += ct
+    eng.finish_chunk_prefill(0, state)
+    eng.check_invariants()
+    assert np.array_equal(np.asarray(logits), one_shot)
+
+
+def test_chunked_bounds_cotenant_itl_tail_on_the_tick_clock():
+    """The point of the feature, on the deterministic work-charged
+    clock: a long prompt admitted mid-run opens an inter-token gap in
+    the co-tenant stream equal to its WHOLE prefill when monolithic,
+    but bounded near chunk_tokens when chunked — with the committed
+    streams themselves identical."""
+    from apex_tpu.serving import Tracer
+
+    cfg = _cfg()
+    params = _params(cfg)
+    reqs = [Request(prompt=(5, 3), max_new_tokens=12),
+            Request(prompt=(3, 5), max_new_tokens=4),
+            Request(prompt=tuple(range(2, 26)), max_new_tokens=2)]
+
+    def run(chunk_tokens):
+        trc = Tracer()
+        engine = PagedDecodeEngine(params, cfg, num_slots=2,
+                                   max_len=MAX_LEN, num_pages=24,
+                                   page_size=4, buckets=(16, 32),
+                                   tracer=trc)
+        # eos_id=-1: unreachable, so the co-tenant really decodes all
+        # 12 tokens while the long prompt prefills
+        sched = ContinuousBatchingScheduler(engine, eos_id=-1,
+                                            chunk_tokens=chunk_tokens)
+        for r in reqs:
+            sched.submit(r)
+        return sched.run(), trc.latency_summary()["itl_p99"]
+
+    streams_c, tail_chunked = run(4)
+    streams_m, tail_mono = run(None)
+    assert streams_c == streams_m       # identity first, then latency
+    assert tail_chunked < tail_mono     # the tail actually collapsed
+
+
+def test_chunk_config_validation():
+    """chunk_tokens must be >= 1, divide max_len, be page-aligned on a
+    paged engine, and is refused over the int8 page pool; the tick
+    token budget must be positive."""
+    import jax.numpy as jnp
+
+    cfg = _cfg()
+    params = _params(cfg)
+    dense = DecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN)
+    paged = PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                              num_pages=8, page_size=4,
+                              buckets=(16, 32))
+    with pytest.raises(ValueError, match=">= 1"):
+        ContinuousBatchingScheduler(dense, eos_id=EOS, chunk_tokens=0)
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatchingScheduler(dense, eos_id=EOS, chunk_tokens=5)
+    with pytest.raises(ValueError, match="page_size"):
+        ContinuousBatchingScheduler(paged, eos_id=EOS, chunk_tokens=2)
+    int8 = PagedDecodeEngine(params, cfg, num_slots=1, max_len=MAX_LEN,
+                             num_pages=8, page_size=4, buckets=(16, 32),
+                             cache_dtype=jnp.int8)
+    with pytest.raises(ValueError, match="int8"):
+        ContinuousBatchingScheduler(int8, eos_id=EOS, chunk_tokens=4)
+    with pytest.raises(ValueError, match="tick_token_budget"):
+        ContinuousBatchingScheduler(dense, eos_id=EOS, chunk_tokens=4,
+                                    tick_token_budget=0)
