@@ -33,9 +33,11 @@ linted set, and seeds them into that file's reachability frontier.
 
 Beyond the stdlib host modules, apex_tpu's OWN host state is
 registered: ``serving.faults`` (fault schedules, call counters),
-``serving.health`` (``ServingStats`` degradation counters), and
-``serving.observe`` (tracer flags, metric registries, flight-recorder
-rings) exist to be mutated between ticks, so reading them inside a
+``serving.health`` (``ServingStats`` degradation counters, replica
+health ladders), ``serving.observe`` (tracer flags, metric registries,
+flight-recorder rings), ``serving.transfer`` (handoff attempt
+counters), and ``serving.router`` (replica roles, admission charges)
+exist to be mutated between ticks, so reading them inside a
 traced body freezes a counter value into the compiled program — the
 canonical staleness bug this tier exists for. Any use of those
 modules' stateful classes — or of a module-level instance constructed
@@ -65,11 +67,15 @@ _DECORATOR_ROOTS = {"custom_vjp", "custom_jvp", "jit", "checkpoint",
 #: reading them bakes one stale value into the compiled program.
 _HOST_STATE_MODULES = {"apex_tpu.serving.faults",
                        "apex_tpu.serving.health",
-                       "apex_tpu.serving.observe"}
+                       "apex_tpu.serving.observe",
+                       "apex_tpu.serving.transfer",
+                       "apex_tpu.serving.router"}
 #: The stateful classes those modules export (re-exported by
 #: ``apex_tpu.serving``); instances are mutated on the host every tick.
 _HOST_STATE_SYMBOLS = {"FaultInjector", "ServingStats", "Tracer",
-                       "MetricsRegistry", "FlightRecorder"}
+                       "MetricsRegistry", "FlightRecorder",
+                       "PageTransfer", "ReplicaHealth",
+                       "DisaggregatedRouter"}
 
 
 def _host_modules(tree: ast.Module) -> Dict[str, str]:

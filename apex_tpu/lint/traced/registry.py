@@ -724,6 +724,41 @@ def _paged_chunk_prefill_step_medium_entry():
     return build
 
 
+def _page_handoff_medium_entry():
+    """r15 cost anchor: the receiver half of a disaggregated page
+    handoff — ``serving.transfer.make_insert_pages_fn`` scattering one
+    full prompt's tiles (8 pages x 64 tokens = a 512-token prompt)
+    into the ragged medium pool (32 slots, s_max 512, page 64, bf16).
+    The donated in-place scatter prices the handoff at ~the shipped
+    tile bytes (2 x L x H x page x head_dim x 2 per page), which is
+    what the BASELINE r15 verdict compares against a decode step's
+    parameter read — the bytes disaggregation moves once per prompt to
+    unblock every co-tenant decode tick."""
+    def build():
+        import functools as ft
+
+        import jax
+
+        from apex_tpu.models.gpt import GPTConfig
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.transfer import make_insert_pages_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        n = s_max // page  # one max-length prompt's page tile
+        tile = _sds((cfg.num_layers, n, cfg.num_heads, page,
+                     cfg.head_dim), "bfloat16")
+        fn = make_insert_pages_fn()
+        return fn, (cache, _sds((n,), "int32"), tile, tile)
+
+    return build
+
+
 def _paged_decode_step_entry(tp=None):
     """Paged decode: scatter the new row through the block table, then
     gather each slot's pages and attend (APX105 pins this file's
@@ -1354,6 +1389,12 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_paged_chunk_prefill_step_medium",
                    "apex_tpu.serving.decode",
                    _paged_chunk_prefill_step_medium_entry(), checks=()),
+        # r15: the disaggregated handoff's receiver scatter at the same
+        # ragged medium shape — budgets.json pins the per-prompt-page
+        # handoff bytes the router ships between replicas
+        TraceEntry("gpt_page_handoff_medium",
+                   "apex_tpu.serving.transfer",
+                   _page_handoff_medium_entry(), checks=()),
         # r13: the model drafter's per-token forward at the medium
         # shape — the draft_bytes numerator of the break-even condition
         # (BASELINE.md r13); its hand-tightened ceiling pins the draft

@@ -48,7 +48,19 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   streams, Perfetto JSONL dumps), a ``MetricsRegistry`` of counters/
   gauges/latency histograms (``ServingStats`` is a view over it), and
   a ``FlightRecorder`` ring that typed ``ServingError``\\ s attach to
-  their payloads.
+  their payloads;
+- ``transfer``  — fault-tolerant cross-replica page handoff: page
+  tiles gathered from a prefill replica's pool and scattered into a
+  decode replica's, content-addressed by the chained prefix keys,
+  checksum-verified (corrupt payloads quarantined, never attended),
+  retried under a per-handoff budget with every outcome typed;
+- ``router``    — the disaggregated serving tier: a
+  ``DisaggregatedRouter`` (a ``ContinuousBatchingScheduler`` over a
+  two-replica composite engine) admitting prompts on a prefill
+  replica, shipping their pages across, decoding on a decode replica
+  — with per-replica ``ReplicaHealth`` ladders driven by probe faults,
+  graceful colocated fallback, and mid-stream failover whose committed
+  streams stay bit-identical to colocated serving.
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
@@ -72,18 +84,27 @@ from apex_tpu.serving.faults import (  # noqa: F401
     SITES, FaultInjector, InjectedFault, fault_draw,
 )
 from apex_tpu.serving.health import (  # noqa: F401
-    FINISH_REASONS, AdmissionRejected, DeadlineExceeded, LivelockError,
-    NonFiniteLogits, PoolExhausted, PoolInvariantError, RequestOutcome,
-    RetryBudgetExhausted, ServingError, ServingStats,
+    FINISH_REASONS, HEALTH_STATES, AdmissionRejected, DeadlineExceeded,
+    LivelockError, NonFiniteLogits, PoolExhausted, PoolInvariantError,
+    ReplicaHealth, ReplicaUnavailable, RequestOutcome,
+    RetryBudgetExhausted, ServingError, ServingStats, TransferCorrupt,
+    TransferFailed,
 )
 from apex_tpu.serving.observe import (  # noqa: F401
     FlightRecorder, MetricsRegistry, TraceEvent, Tracer,
 )
-from apex_tpu.serving.paging import PagePool, prefix_page_keys  # noqa: F401
+from apex_tpu.serving.paging import (  # noqa: F401
+    PAGE_KEY_VERSION, PagePool, prefix_page_keys,
+)
+from apex_tpu.serving.router import DisaggregatedRouter  # noqa: F401
 from apex_tpu.serving.sampling import (  # noqa: F401
     finite_rows, sample_token_grid, sample_tokens, speculative_accept,
     tree_speculative_accept,
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
+)
+from apex_tpu.serving.transfer import (  # noqa: F401
+    PageTransfer, make_extract_pages_fn, make_insert_pages_fn,
+    make_tile_transfer_fns, transfer_checksum,
 )

@@ -46,6 +46,26 @@ executables stay fault-free):
                    raises :class:`InjectedFault` — the scheduler empties
                    every draft (plain tick). No rung charges retry
                    budget; the stream stays bit-identical throughout
+``page_send``      one cross-replica page-handoff attempt fails before
+                   any bytes move (``serving.transfer.PageTransfer``) —
+                   a dropped/late send. The transfer retries under its
+                   per-transfer budget; exhaustion raises
+                   :class:`~apex_tpu.serving.health.TransferFailed` and
+                   the router falls back to colocated prefill
+``page_recv``      the received page payload is corrupted in flight
+                   (one staged byte flipped, payload-selected). The
+                   receiver's checksum verification catches it, the
+                   corrupt tiles are QUARANTINED (never installed, never
+                   attended), and the attempt counts against the same
+                   retry budget as ``page_send``
+``replica_health`` one replica health probe fails
+                   (``serving.router.DisaggregatedRouter`` draws once
+                   per replica per tick, in fixed replica order).
+                   Consecutive failures walk the replica down the
+                   healthy -> degraded -> down ladder
+                   (``serving.health.ReplicaHealth``); a down remote
+                   stops receiving prefills, a down ACTIVE replica
+                   triggers mid-stream failover
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from
@@ -58,7 +78,8 @@ from typing import Dict, Iterable, Mapping, Optional, Tuple
 
 #: The named fault sites, in the order the docs list them.
 SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
-         "decode_exec", "sample", "draft_exec")
+         "decode_exec", "sample", "draft_exec", "page_send", "page_recv",
+         "replica_health")
 
 
 class InjectedFault(RuntimeError):

@@ -42,7 +42,27 @@ Taxonomy (all subclass :class:`ServingError`):
 :class:`PoolInvariantError` the runtime audit
                             (``PagePool.check_invariants``) found the
                             allocator's books inconsistent
+:class:`TransferFailed`     a cross-replica page handoff exhausted its
+                            per-transfer retry budget (every attempt
+                            dropped at the ``page_send`` site); the
+                            router falls back to colocated prefill
+:class:`TransferCorrupt`    the received page payload failed checksum /
+                            page-key verification — the tiles are
+                            quarantined (never installed, never
+                            attended) and the attempt retried
+:class:`ReplicaUnavailable` a routing target is unusable: its health
+                            state is ``down``, or its own page pool
+                            refused the prompt — the router serves the
+                            request colocated on the surviving engine
 ==========================  ===============================================
+
+The disaggregated tier adds one piece of host-side *state* here too:
+:class:`ReplicaHealth`, the per-replica probe-driven
+healthy → degraded → down ladder the
+:class:`~apex_tpu.serving.router.DisaggregatedRouter` consults before
+routing a prefill to the remote replica (and to decide mid-stream
+failover when the ACTIVE replica goes down). Like the counters it is
+plain Python — APX401 host state.
 """
 
 import dataclasses
@@ -134,6 +154,129 @@ class PoolInvariantError(ServingError):
     audit, ``PagePool.check_invariants``."""
 
 
+class TransferFailed(ServingError):
+    """A cross-replica page handoff exhausted its per-transfer retry
+    budget (every attempt lost at the ``page_send`` site). Carries the
+    attempt count and the page batch size; the router catches it and
+    serves the admission colocated — the request never sees it."""
+
+    def __init__(self, msg: str, *, attempts: int = 0, pages: int = 0):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.pages = pages
+        self.payload.update(attempts=attempts, pages=pages)
+
+
+class TransferCorrupt(ServingError):
+    """A received page payload failed verification: the transfer
+    checksum (sha256 over the staged K/V tile bytes + the chained
+    prefix page key) did not match what the sender computed. The tiles
+    are QUARANTINED — discarded without ever being installed into the
+    receiving pool, so corrupt KV rows are never attended. Raised out
+    of the transfer only when corruption also exhausted the retry
+    budget; the router then falls back colocated."""
+
+    def __init__(self, msg: str, *, attempts: int = 0, pages: int = 0):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.pages = pages
+        self.payload.update(attempts=attempts, pages=pages)
+
+
+class ReplicaUnavailable(ServingError):
+    """A routing target cannot serve: its :class:`ReplicaHealth` is
+    ``down``, or its own page pool refused the prompt's pages. The
+    router catches it and degrades to colocated prefill+decode on the
+    surviving engine — a dead replica yields this typed diagnostic,
+    never a hang."""
+
+    def __init__(self, msg: str, *, replica: str = ""):
+        super().__init__(msg)
+        self.replica = replica
+        self.payload.update(replica=replica)
+
+
+#: ``ReplicaHealth`` states, worst first. The index doubles as the
+#: ``serving_replica_health`` gauge value (0 = down .. 2 = healthy) so
+#: dashboards can alert on ``< 2`` without string labels.
+HEALTH_STATES = ("down", "degraded", "healthy")
+
+
+class ReplicaHealth:
+    """Per-replica probe-driven health ladder: ``healthy`` → ``degraded``
+    → ``down``, one rung per failed observation, with hysteresis on the
+    way back up (``recover_after`` CONSECUTIVE successes per rung — a
+    flapping replica cannot oscillate straight back into the routing
+    set). Observations come from two places, both deterministic: the
+    router's per-tick ``replica_health`` fault-site probes, and real
+    transfer/prefill outcomes against the replica (a failed handoff
+    attempt is evidence exactly like a failed probe).
+
+    ``routable`` gates routing: ``down`` replicas receive no prefills
+    and trigger failover when they back the active slots. The state is
+    exported as the ``serving_replica_health`` gauge (per-replica
+    label) on every transition and probe.
+
+    Host state (APX401): never read inside a traced function.
+    """
+
+    def __init__(self, name: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 recover_after: int = 2):
+        if recover_after < 1:
+            raise ValueError(
+                f"recover_after must be >= 1, got {recover_after}")
+        self.name = name
+        self.state = "healthy"
+        self.recover_after = recover_after
+        self._ok_streak = 0
+        self.transitions = 0
+        self._gauge = None if registry is None else registry.gauge(
+            "serving_replica_health",
+            help="replica health ladder (2 healthy / 1 degraded / "
+                 "0 down)", labels={"replica": name})
+        self._export()
+
+    def _export(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(HEALTH_STATES.index(self.state))
+
+    @property
+    def routable(self) -> bool:
+        """May receive new work (``down`` replicas may not; ``degraded``
+        ones still serve — they are one failure from the exit, not out)."""
+        return self.state != "down"
+
+    def probe(self, ok: bool) -> str:
+        """Fold one observation (probe result, transfer outcome, remote
+        prefill outcome) into the ladder and return the new state."""
+        prev = self.state
+        if ok:
+            self._ok_streak += 1
+            if self._ok_streak >= self.recover_after \
+                    and self.state != "healthy":
+                self.state = ("degraded" if self.state == "down"
+                              else "healthy")
+                self._ok_streak = 0
+        else:
+            self._ok_streak = 0
+            if self.state == "healthy":
+                self.state = "degraded"
+            elif self.state == "degraded":
+                self.state = "down"
+        if self.state != prev:
+            self.transitions += 1
+            self._export()
+        elif self._gauge is not None and self._gauge.value \
+                != HEALTH_STATES.index(self.state):
+            self._export()
+        return self.state
+
+    def __repr__(self):
+        return (f"ReplicaHealth({self.name!r}, state={self.state!r}, "
+                f"ok_streak={self._ok_streak})")
+
+
 #: ``ServingStats`` counter fields -> help text. Order defines the
 #: ``as_dict`` / Prometheus export order; each field is backed by a
 #: ``serving_<field>_total`` counter in the stats' MetricsRegistry.
@@ -153,6 +296,14 @@ STAT_FIELDS = {
     "spec_ticks": "verify-step ticks (linear or tree)",
     "plain_ticks": "single-token decode ticks",
     "prefill_chunks": "chunked-prefill chunk forwards run",
+    "remote_prefills": "admissions prefilled on the remote replica",
+    "colocated_prefills": "admissions served colocated (fallback)",
+    "transfers": "page handoffs delivered and verified",
+    "transfer_pages_deduped": "handoff pages skipped: receiver held them",
+    "transfer_retries": "page-handoff attempts retried",
+    "transfer_corrupt": "handoff payloads quarantined on checksum",
+    "transfer_failures": "handoffs abandoned (budget exhausted)",
+    "failovers": "active-replica switches (slots drained + requeued)",
 }
 
 

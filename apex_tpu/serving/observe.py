@@ -48,13 +48,16 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 #: Per-tick phase spans, in tick order. ``exec`` covers the jitted
 #: decode / verify / tree-verify dispatch inside the engine;
 #: ``chunk_prefill`` one jitted prompt-chunk forward (several may run
-#: per tick, one span each); the rest are host-side scheduler phases.
+#: per tick, one span each); ``page_transfer`` one cross-replica page
+#: handoff (``serving.transfer.PageTransfer``, retries included in the
+#: span); the rest are host-side scheduler phases.
 PHASES = ("draft", "prepare_decode", "exec", "accept", "commit",
-          "chunk_prefill")
+          "chunk_prefill", "page_transfer")
 
 #: Per-request lifecycle instants.
 LIFECYCLE = ("submitted", "admitted", "prefill", "first_token",
-             "preempted", "retried", "quarantined", "finished")
+             "preempted", "retried", "quarantined", "failover",
+             "finished")
 
 #: Default histogram buckets for tick-denominated latencies (TTFT,
 #: inter-token). Roughly geometric: fine where SLOs live, coarse in

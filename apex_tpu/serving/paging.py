@@ -42,6 +42,7 @@ is placement-invariant anyway (see ``_paged_decode_attention``).
 """
 
 import hashlib
+import struct
 from collections import Counter, OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -49,19 +50,43 @@ from apex_tpu.serving.cache import RESERVED_PAGES
 from apex_tpu.serving.faults import FaultInjector
 from apex_tpu.serving.health import PoolInvariantError
 
+#: Version tag baked into every hashed page record. The chained key is
+#: a CROSS-REPLICA content address (prefix cache, transfer dedup, and
+#: transfer integrity all compare raw digests), so the byte layout
+#: under the hash is a wire format: bump this when it changes and the
+#: old generation's keys simply never match — no silent aliasing.
+PAGE_KEY_VERSION = 1
+
+
+def _encode_page(page: Sequence[int]) -> bytes:
+    """Canonical byte record for one page of token ids: a
+    ``struct.pack``'d little-endian layout — ``<II`` header (version,
+    token count) followed by one ``<i`` int32 per token. Replaces the
+    original ``repr(page).encode()``, whose text form depended on the
+    Python int formatting of the host that hashed it — too fragile to
+    serve as a content address two replicas must agree on. int32 is
+    deliberate: token ids are vocabulary indices, and ``struct.pack``
+    raises on anything outside int32 range rather than truncating."""
+    return struct.pack(f"<II{len(page)}i", PAGE_KEY_VERSION,
+                       len(page), *page)
+
 
 def prefix_page_keys(tokens: Sequence[int],
                      page_size: int) -> List[bytes]:
     """One chained content key per page of ``tokens`` (the last page
     may be partial — its key commits to the partial contents, so only
-    an EXACT partial match shares it)."""
+    an EXACT partial match shares it). Key ``i`` is
+    ``sha256(key[i-1] + encode(page_i))`` over the canonical
+    :func:`_encode_page` layout, so it commits to every token of pages
+    ``0..i`` and the same prompt hashes identically on every replica
+    (the encoding-stability test pins exact digests)."""
     if page_size < 1:
         raise ValueError(f"page_size must be positive, got {page_size}")
     keys: List[bytes] = []
     h = b""
     for start in range(0, len(tokens), page_size):
         page = tuple(int(t) for t in tokens[start:start + page_size])
-        h = hashlib.sha256(h + repr(page).encode()).digest()
+        h = hashlib.sha256(h + _encode_page(page)).digest()
         keys.append(h)
     return keys
 

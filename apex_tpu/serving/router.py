@@ -1,0 +1,434 @@
+"""Disaggregated prefill/decode serving: two engines, one scheduler.
+
+Prefill is MXU-bound and decode is HBM-bound (BASELINE r8/r9), so the
+"millions of users" topology runs them on SEPARATE replicas — prompt
+forwards on a prefill engine, decode ticks on a decode engine — with
+the finished prompt pages shipped between them by the fault-tolerant
+:class:`~apex_tpu.serving.transfer.PageTransfer` channel, keyed and
+deduped by the chained content hashes of
+:func:`~apex_tpu.serving.paging.prefix_page_keys`.
+
+The design reuses the whole serving stack instead of forking it: the
+:class:`DisaggregatedRouter` IS a
+:class:`~apex_tpu.serving.scheduler.ContinuousBatchingScheduler` whose
+engine is a composite (:class:`_DisaggEngine`) presenting the standard
+``DecodeEngine`` interface. Every decode-path method delegates to the
+ACTIVE replica (the one backing the slots); only ``prefill`` routes:
+
+1. remote replica ``routable`` → run the prompt forward there, ship
+   the non-shared pages across, install them into pages the active
+   pool allocated (same order a local prefill would), register the
+   prefix chain, return the logits. The slot's cache row ends up
+   BITWISE identical to a colocated prefill — same jitted program,
+   same inputs, pages copied verbatim — which is why fault-free
+   disaggregated streams are integer-identical to the colocated
+   scheduler's.
+2. remote down, transfer budget exhausted, payload quarantined, or
+   the remote pool refused the prompt → typed error
+   (:class:`~apex_tpu.serving.health.TransferFailed` /
+   :class:`~apex_tpu.serving.health.TransferCorrupt` /
+   :class:`~apex_tpu.serving.health.ReplicaUnavailable`), caught here,
+   and the admission is served COLOCATED on the active engine — the
+   request never observes the degradation (graceful ladder: remote →
+   colocated → scheduler retry budget → typed outcome).
+
+Health and failover: the router draws the ``replica_health`` fault
+site once per replica per tick (fixed order — replay-exact) and folds
+the probes into each replica's
+:class:`~apex_tpu.serving.health.ReplicaHealth` ladder alongside real
+transfer/prefill outcomes. A DOWN remote just stops receiving
+prefills. A DOWN *active* replica triggers mid-stream failover: every
+occupied slot is drained back to the queue front (the preemption
+resume path — re-prefill from prompt + generated, sampling keys fold
+``(seed, n_generated)``, so committed streams stay bit-identical) and
+the replicas swap roles; the recovered ex-active replica later rejoins
+as the remote prefill target. Admission, deadlines, retry budgets, the
+progress watchdog, and flight-recorder attachment all come from the
+base scheduler unchanged — a dead replica produces typed outcomes,
+never a hang.
+
+Clock accounting: a remote prefill runs CONCURRENTLY with the active
+replica's decode ticks, so the router does not charge its sequential
+depth to the work-charged tick clock the way colocated admission does
+— it charges the deterministic handoff cost instead
+(``handoff_ticks_per_page`` per shipped page, plus one backoff tick
+per retry attempt, observed in the ``serving_transfer_ticks``
+histogram). That unblocked-decode gap is exactly the p99 ITL win the
+``serving_disagg_vs_colocated`` A/B pair measures; sampling keys never
+see the clock, so streams are unaffected.
+
+Scope: both replicas must be PAGED engines with identical model
+config/geometry and SHARED injector+tracer (one deterministic fault
+and event sequence). Chunked prefill, model drafters/tree speculation,
+and int8 page pools stay colocated-only for now — the constructor
+refuses them typed.
+
+This module is host state (router bookkeeping, health ladders) —
+APX401 registers it like ``serving.health``/``serving.faults``.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving.cache import NULL_PAGE, max_pages_per_slot
+from apex_tpu.serving.faults import FaultInjector, InjectedFault
+from apex_tpu.serving.health import (PoolExhausted, ReplicaHealth,
+                                     ReplicaUnavailable, TransferCorrupt,
+                                     TransferFailed)
+from apex_tpu.serving.paging import prefix_page_keys
+from apex_tpu.serving.scheduler import ContinuousBatchingScheduler
+from apex_tpu.serving.transfer import PageTransfer, make_insert_pages_fn
+
+#: The remote replica prefills every admission into this slot, then
+#: frees it once the pages have shipped — admissions are sequential,
+#: so one staging slot suffices and the remote pool's prefix registry
+#: (not its slots) carries its cross-request dedup.
+_STAGING_SLOT = 0
+
+#: Fixed health-probe order per tick (initial role names — replay
+#: depends on draw ORDER, not on which replica currently serves).
+_REPLICA_ORDER = ("prefill", "decode")
+
+
+def _require_same(a, b, attr: str) -> None:
+    va, vb = getattr(a, attr), getattr(b, attr)
+    if va != vb:
+        raise ValueError(
+            f"disaggregated replicas must agree on {attr}: "
+            f"prefill={va!r} vs decode={vb!r}")
+
+
+def _validate_replicas(prefill_engine, decode_engine) -> None:
+    if prefill_engine is decode_engine:
+        raise ValueError("disaggregation needs two engine instances")
+    for eng, role in ((prefill_engine, "prefill"),
+                      (decode_engine, "decode")):
+        if not getattr(eng, "paged", False):
+            raise ValueError(
+                f"the {role} replica must be a paged engine: the "
+                "handoff ships page tiles keyed by prefix_page_keys")
+        if getattr(eng.cache, "k_scale", None) is not None:
+            raise ValueError(
+                "disaggregated serving is not offered over the int8 "
+                "page pool: shipped pages would carry page-local "
+                "scales quantized against the SENDER's amax sweep; "
+                "kv8 keeps colocated serving")
+        if eng.draft_model is not None or eng.tree_spec:
+            raise ValueError(
+                "model drafters / tree speculation stay colocated: "
+                "the drafter's lockstep cache would need its own "
+                "cross-replica handoff (n-gram spec_k works "
+                "disaggregated)")
+    for attr in ("cfg", "num_slots", "max_len", "page_size", "buckets",
+                 "spec_k", "top_k", "top_p", "adaptive_spec",
+                 "prefix_sharing"):
+        _require_same(prefill_engine, decode_engine, attr)
+    if prefill_engine.injector is not decode_engine.injector:
+        raise ValueError(
+            "both replicas must share ONE FaultInjector: fault draws "
+            "form a single deterministic sequence (construct both "
+            "engines with the same injector=)")
+    if prefill_engine.tracer is not decode_engine.tracer:
+        raise ValueError(
+            "both replicas must share ONE Tracer: events, metrics and "
+            "the stats view live in a single registry (construct both "
+            "engines with the same tracer=)")
+
+
+class _DisaggEngine:
+    """The composite engine behind :class:`DisaggregatedRouter`:
+    presents the ``DecodeEngine`` interface over two paged replicas.
+    Attribute/method access falls through to the ACTIVE replica (the
+    one whose slots the scheduler drives); ``prefill`` routes per the
+    module doc. Swappable: :meth:`switch_active` exchanges the roles
+    on failover."""
+
+    paged = True
+
+    def __init__(self, prefill_engine, decode_engine,
+                 transfer: PageTransfer,
+                 health: Dict[str, ReplicaHealth],
+                 handoff_ticks_per_page: float,
+                 backoff_ticks: int):
+        # set the delegation table FIRST: __getattr__ consults it
+        self._replicas = {"prefill": prefill_engine,
+                          "decode": decode_engine}
+        self._active_name = "decode"
+        self._remote_name = "prefill"
+        self.transfer = transfer
+        self.health = health
+        self.handoff_ticks_per_page = float(handoff_ticks_per_page)
+        self.backoff_ticks = int(backoff_ticks)
+        self.injector = decode_engine.injector
+        self.tracer = decode_engine.tracer
+        self.stats = decode_engine.stats
+        self._insert = make_insert_pages_fn()
+        self._admit_charge: Optional[int] = None
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.__dict__["_replicas"][
+            self.__dict__["_active_name"]], name)
+
+    @property
+    def active(self):
+        return self._replicas[self._active_name]
+
+    @property
+    def remote(self):
+        return self._replicas[self._remote_name]
+
+    @property
+    def active_name(self) -> str:
+        return self._active_name
+
+    @property
+    def remote_name(self) -> str:
+        return self._remote_name
+
+    # -- health / failover ----------------------------------------------
+
+    def health_tick(self) -> None:
+        """One ``replica_health`` probe per replica, fixed order —
+        the router calls this at the top of every admission pass, so
+        probe draw indices are a pure function of the tick count."""
+        for name in _REPLICA_ORDER:
+            fired, _ = self.injector.draw("replica_health")
+            self.health[name].probe(not fired)
+
+    @property
+    def active_down(self) -> bool:
+        return not self.health[self._active_name].routable
+
+    @property
+    def remote_routable(self) -> bool:
+        return self.health[self._remote_name].routable
+
+    def switch_active(self) -> None:
+        self._active_name, self._remote_name = (self._remote_name,
+                                                self._active_name)
+
+    # -- admission-charge handshake with the router ---------------------
+
+    def pop_admit_charge(self, default: int) -> int:
+        charge, self._admit_charge = self._admit_charge, None
+        return default if charge is None else charge
+
+    # -- routed prefill -------------------------------------------------
+
+    def prefill(self, slot: int, prompt: Sequence[int]):
+        trc = self.tracer
+        if self.remote_routable:
+            try:
+                return self._remote_prefill(slot, prompt)
+            except (TransferFailed, TransferCorrupt,
+                    ReplicaUnavailable) as e:
+                # degrade, don't fail: the admission is served
+                # colocated on the active engine; the request never
+                # sees the transfer/replica fault
+                if trc.enabled:
+                    trc.instant("failover", slot=slot,
+                                cause=type(e).__name__,
+                                replica=self._remote_name)
+        self.stats.colocated_prefills += 1
+        return self.active.prefill(slot, prompt)
+
+    def _remote_prefill(self, slot: int, prompt: Sequence[int]):
+        act, rem = self.active, self.remote
+        rhealth = self.health[self._remote_name]
+        toks = [int(t) for t in prompt]
+        try:
+            logits = rem.prefill(_STAGING_SLOT, toks)
+        except PoolExhausted as e:
+            # remote CAPACITY, not remote failure: no health demerit,
+            # but the admission cannot be staged there right now
+            raise ReplicaUnavailable(
+                f"remote replica {self._remote_name!r} page pool "
+                f"refused the prompt: {e}",
+                replica=self._remote_name) from e
+        except InjectedFault:
+            # a transient device fault on the remote replica: the
+            # remote engine rolled its page references back; propagate
+            # so the scheduler charges the retry budget exactly like a
+            # colocated prefill fault — and let repeated faults walk
+            # the replica down the ladder toward colocated routing
+            rhealth.probe(False)
+            raise
+        # allocate the destination pages in the SAME order a colocated
+        # prefill would: longest registered prefix run shared, the
+        # remainder fresh from the active pool
+        keys = prefix_page_keys(toks, act.page_size)
+        n_pages = max_pages_per_slot(len(toks), act.page_size)
+        shared = act.pool.match_prefix(keys) if act.prefix_sharing \
+            else []
+        private: List[int] = []
+        for _ in range(n_pages - len(shared)):
+            p = act.pool.alloc()
+            if p is None:
+                for q in shared + private:
+                    act.pool.release(q)
+                rem.free_slot(_STAGING_SLOT)
+                raise PoolExhausted(
+                    f"prompt needs {n_pages} pages; pool has "
+                    f"{act.pool.num_free} free and nothing left to "
+                    "evict", need=n_pages, free=act.pool.num_free,
+                    cached=act.pool.num_cached)
+            private.append(p)
+        src_pages = rem._slot_pages[_STAGING_SLOT][len(shared):n_pages]
+        self.stats.transfer_pages_deduped += len(shared)
+        try:
+            k_tile, v_tile, attempts = self.transfer.ship(
+                rem, toks, src_pages, replica=self._remote_name,
+                health=rhealth)
+        except (TransferFailed, TransferCorrupt):
+            for q in shared + private:
+                act.pool.release(q)
+            rem.free_slot(_STAGING_SLOT)
+            raise
+        pages = shared + private
+        row = np.full((act.max_pages,), NULL_PAGE, np.int32)
+        row[:n_pages] = pages
+        # install: block-table row + true prompt length (exactly what
+        # the jitted colocated prefill writes), then scatter the
+        # verified tiles into the private pages
+        act.cache = act.cache._replace(
+            block_tables=act.cache.block_tables.at[slot].set(
+                jnp.asarray(row)),
+            lengths=act.cache.lengths.at[slot].set(
+                jnp.int32(len(toks))))
+        if private:
+            k_dev, v_dev = self.transfer.shard_fn(k_tile, v_tile)
+            act.cache = self._insert(
+                act.cache, jnp.asarray(private, jnp.int32), k_dev,
+                v_dev)
+        act._slot_pages[slot] = list(pages)
+        if act.prefix_sharing:
+            act.pool.register_prefix(keys, pages)
+        rem.free_slot(_STAGING_SLOT)
+        self.stats.remote_prefills += 1
+        ticks = self._handoff_ticks(len(private), attempts)
+        self._admit_charge = ticks
+        self.transfer.observe_ticks(self._remote_name, ticks)
+        # the logits hop replicas with the pages (a 1 x vocab row —
+        # noise next to the tiles); values survive the host round-trip
+        # bit-for-bit
+        return jnp.asarray(np.asarray(logits))
+
+    def _handoff_ticks(self, shipped_pages: int, attempts: int) -> int:
+        """Deterministic clock cost of a delivered handoff: the shipped
+        bytes at ``handoff_ticks_per_page`` (a page is a small fraction
+        of a decode step's HBM read — the cost-tier entry pins the
+        ratio), floored at one control tick, plus one backoff tick per
+        failed attempt."""
+        moved = int(np.ceil(shipped_pages * self.handoff_ticks_per_page))
+        return max(1, moved) + (attempts - 1) * self.backoff_ticks
+
+    # -- audit / diagnostics over BOTH replicas -------------------------
+
+    def check_invariants(self) -> bool:
+        self.active.check_invariants()
+        self.remote.check_invariants()
+        return True
+
+    def pool_snapshot(self) -> Dict:
+        return {"active": {"replica": self._active_name,
+                           **self.active.pool_snapshot()},
+                "remote": {"replica": self._remote_name,
+                           **self.remote.pool_snapshot()}}
+
+    def pool_gauges(self) -> Dict[str, float]:
+        # the tick gauges track the pool the slots live in; the remote
+        # pool's story is told by the per-replica transfer metrics
+        return self.active.pool_gauges()
+
+
+class DisaggregatedRouter(ContinuousBatchingScheduler):
+    """The two-replica serving tier (see module doc): a
+    ``ContinuousBatchingScheduler`` over a :class:`_DisaggEngine`
+    composite, plus per-tick health probes and mid-stream failover.
+
+    ``transfer_max_retries`` bounds re-attempts per page handoff;
+    ``handoff_ticks_per_page`` / ``backoff_ticks`` set the
+    deterministic clock cost of a delivered handoff (see
+    ``_handoff_ticks``); ``recover_after`` is each replica's
+    consecutive-success hysteresis on the way back up the health
+    ladder. All remaining keywords are the base scheduler's
+    (``chunk_tokens`` excepted — chunked prefill stays colocated)."""
+
+    def __init__(self, prefill_engine, decode_engine, eos_id: int, *,
+                 transfer_max_retries: int = 2,
+                 handoff_ticks_per_page: float = 0.125,
+                 backoff_ticks: int = 1,
+                 recover_after: int = 2,
+                 transfer: Optional[PageTransfer] = None,
+                 **kwargs):
+        _validate_replicas(prefill_engine, decode_engine)
+        if kwargs.get("chunk_tokens") is not None:
+            raise ValueError(
+                "chunked prefill stays colocated: the disaggregated "
+                "router runs monolithic admission prefill on the "
+                "remote replica (the chunks would serialize against "
+                "the very decode ticks disaggregation unblocks)")
+        tracer = decode_engine.tracer
+        registry = tracer.registry
+        health = {name: ReplicaHealth(name, registry=registry,
+                                      recover_after=recover_after)
+                  for name in _REPLICA_ORDER}
+        if transfer is None:
+            transfer = PageTransfer(injector=decode_engine.injector,
+                                    tracer=tracer,
+                                    stats=decode_engine.stats,
+                                    max_retries=transfer_max_retries)
+        engine = _DisaggEngine(prefill_engine, decode_engine, transfer,
+                               health, handoff_ticks_per_page,
+                               backoff_ticks)
+        super().__init__(engine, eos_id, **kwargs)
+
+    @property
+    def health(self) -> Dict[str, ReplicaHealth]:
+        return self.engine.health
+
+    def _charge_work(self, tokens: int) -> None:
+        # a remote prefill left its handoff cost with the adapter; a
+        # colocated one charges its sequential depth like the base
+        # scheduler (the remote forward overlaps decode — that gap is
+        # the disaggregation win)
+        super()._charge_work(self.engine.pop_admit_charge(tokens))
+
+    def _admit(self) -> None:
+        eng = self.engine
+        eng.health_tick()
+        if eng.active_down and eng.remote_routable:
+            self._failover()
+        super()._admit()
+
+    def _failover(self) -> None:
+        """The ACTIVE replica went down mid-stream: drain every
+        occupied slot back to the queue FRONT in submission order (the
+        preemption resume path — bit-identical continuation) and swap
+        roles; admission continues this same tick on the survivor.
+        When BOTH replicas are down the router keeps serving on the
+        incumbent instead (last replica standing: health gates
+        routing, not survival)."""
+        eng = self.engine
+        trc = self.tracer
+        occupied = [(i, s) for i, s in enumerate(self._slots)
+                    if s is not None]
+        if trc.enabled:
+            trc.instant("failover", slots=len(occupied),
+                        replica=eng.active_name)
+        old = eng.active
+        for i, s in sorted(occupied, key=lambda t: t[1].request_id,
+                           reverse=True):
+            if trc.enabled:
+                trc.instant("preempted", request_id=s.request_id,
+                            slot=i, cause="failover")
+            self._queue.appendleft((s.request_id, s.request,
+                                    list(s.generated)))
+            self._slots[i] = None
+            old.free_slot(i)
+        eng.switch_active()
+        self.stats.failovers += 1

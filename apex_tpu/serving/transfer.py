@@ -1,0 +1,301 @@
+"""Fault-tolerant cross-replica page handoff for disaggregated serving.
+
+The disaggregated tier (``serving.router``) runs prefill and decode on
+separate engines; what moves between them is the prompt's completed KV
+pages — page-sized ``(layers, heads, page_size, head_dim)`` tiles
+gathered from the prefill replica's pool and scattered into pages the
+decode replica's :class:`~apex_tpu.serving.paging.PagePool` allocated.
+This module owns that channel, and its design goal is the robustness
+contract, not the copy itself:
+
+- **content addressing** — every shipped batch is identified by the
+  prompt's chained sha256 prefix keys
+  (:func:`~apex_tpu.serving.paging.prefix_page_keys`, canonical
+  ``struct.pack`` encoding). The receiver already holding a key's page
+  skips the bytes entirely (cross-replica dedup — the same sharing the
+  local prefix cache provides), and the final chain key is folded into
+  the transfer checksum so a payload can never be installed under the
+  wrong prompt.
+- **integrity** — the sender checksums the staged tile bytes plus the
+  chain key (sha256); the receiver recomputes before installing.
+  A mismatch (the ``page_recv`` fault site flips one staged byte,
+  payload-selected) QUARANTINES the payload: the tiles are discarded
+  without touching the receiving cache, so corrupt KV rows are never
+  attended. Typed: :class:`~apex_tpu.serving.health.TransferCorrupt`.
+- **retry budget** — each handoff gets ``max_retries`` re-attempts
+  (``page_send`` drops count too); exhaustion raises
+  :class:`~apex_tpu.serving.health.TransferFailed` /
+  ``TransferCorrupt`` and the router serves the admission colocated.
+  Every outcome is also an observation for the remote replica's
+  :class:`~apex_tpu.serving.health.ReplicaHealth` ladder.
+- **observability** — one ``page_transfer`` tracer span per handoff
+  (retries inside the span), per-replica labeled counters
+  (``serving_transfer_src_bytes_total`` etc.), and the
+  ``serving_transfer_ticks`` histogram of the deterministic tick cost
+  the router charges per handoff.
+
+Device mechanics: the jitted :func:`make_extract_pages_fn` /
+:func:`make_insert_pages_fn` pair gathers/scatters tiles by page id
+(one executable per distinct page count — prompts are bucketed, so the
+count set is small), staged through the host. On a real two-slice
+topology the staging hop is the ``device_get``/``device_put`` pair of
+``partition.rules.make_shard_and_gather_fns`` over the two sub-meshes
+of ``partition.mesh.make_mesh`` — :func:`make_tile_transfer_fns` builds
+exactly that pair from the pool's TP layout (heads over ``model``);
+the single-device default degenerates to a host round-trip, which is
+also what keeps CPU chaos tests byte-faithful.
+
+The :class:`PageTransfer` object itself is host state (attempt
+counters, metric handles) — APX401 registers this module accordingly;
+the jitted extract/insert closures touch none of it.
+"""
+
+import hashlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serving.faults import FaultInjector
+from apex_tpu.serving.health import (ServingStats, TransferCorrupt,
+                                     TransferFailed)
+from apex_tpu.serving.observe import Tracer
+
+#: ``serving_transfer_ticks`` histogram buckets: handoffs are charged
+#: a handful of decode-step equivalents, not hundreds.
+TRANSFER_TICK_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0,
+                         24.0, 32.0)
+
+
+def make_extract_pages_fn() -> Callable:
+    """Jitted ``(cache, page_ids) -> (k_tile, v_tile)``: gather the
+    identified pages out of a paged cache's pool — the sender half of
+    the handoff. Tiles are ``(layers, n_pages, heads, page_size,
+    head_dim)`` in the pool dtype. Read-only (no donation): the source
+    cache keeps serving its own slots."""
+
+    def extract(cache, page_ids):
+        return cache.k[:, page_ids], cache.v[:, page_ids]
+
+    return jax.jit(extract)
+
+
+def make_insert_pages_fn() -> Callable:
+    """Jitted ``(cache, page_ids, k_tile, v_tile) -> cache``: scatter
+    received tiles into the identified pages of the receiving pool —
+    the receiver half of the handoff, and the cost-tier entry that
+    prices the handoff bytes (``gpt_page_handoff_medium``). The cache
+    is donated: the scatter is an in-place page write, exactly like a
+    decode step's row append."""
+
+    def insert(cache, page_ids, k_tile, v_tile):
+        return cache._replace(k=cache.k.at[:, page_ids].set(k_tile),
+                              v=cache.v.at[:, page_ids].set(v_tile))
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+def make_tile_transfer_fns(mesh=None, rules=None) -> Tuple[Callable,
+                                                           Callable]:
+    """``(gather_fn, shard_fn)`` for page tiles on a real multi-device
+    topology: ``gather_fn`` pulls a (possibly TP-sharded) tile pair to
+    replicated host arrays on the source sub-mesh, ``shard_fn`` places
+    host tiles under the pool's TP spec (heads over ``model``) on the
+    destination sub-mesh — the ``make_shard_and_gather_fns`` device_put
+    /device_get pair from the partition engine, applied to the tile's
+    head axis (axis 2, same as the pool's). Build one pair per sub-mesh
+    of ``partition.mesh.make_mesh`` and hand them to
+    :class:`PageTransfer`; without them the transfer stages through
+    ``np.asarray`` — correct on any topology, optimal on one device."""
+    from jax.sharding import PartitionSpec
+
+    from apex_tpu.partition.rules import make_shard_and_gather_fns
+
+    del rules  # the tile layout is fixed by the pool's: heads sharded
+    spec = PartitionSpec(None, None, "model")
+    shard_fns, gather_fns = make_shard_and_gather_fns(
+        {"k": spec, "v": spec}, mesh)
+
+    def gather_fn(k_tile, v_tile):
+        return (np.asarray(gather_fns["k"](k_tile)),
+                np.asarray(gather_fns["v"](v_tile)))
+
+    def shard_fn(k_tile, v_tile):
+        return shard_fns["k"](k_tile), shard_fns["v"](v_tile)
+
+    return gather_fn, shard_fn
+
+
+def _default_gather(k_tile, v_tile):
+    return np.asarray(k_tile), np.asarray(v_tile)
+
+
+def _default_shard(k_tile, v_tile):
+    return k_tile, v_tile
+
+
+def transfer_checksum(k_tile: np.ndarray, v_tile: np.ndarray,
+                      chain_key: bytes) -> bytes:
+    """sha256 over the staged tile bytes plus the prompt's final
+    chained page key: integrity (bit flips) and identity (a payload
+    can only verify against the prompt whose pages it carries) in one
+    digest."""
+    h = hashlib.sha256()
+    h.update(chain_key)
+    h.update(np.ascontiguousarray(k_tile).tobytes())
+    h.update(np.ascontiguousarray(v_tile).tobytes())
+    return h.digest()
+
+
+class PageTransfer:
+    """The fault-tolerant handoff channel (see module doc). One
+    instance per router; both replicas' engines share its injector and
+    tracer, so fault draws and spans land in a single deterministic
+    sequence.
+
+    ``max_retries`` bounds RE-attempts per handoff (total attempts =
+    ``max_retries + 1``). ``gather_fn``/``shard_fn`` override the host
+    staging hop for real two-mesh topologies
+    (:func:`make_tile_transfer_fns`)."""
+
+    def __init__(self, injector: Optional[FaultInjector] = None,
+                 tracer: Optional[Tracer] = None,
+                 stats: Optional[ServingStats] = None,
+                 max_retries: int = 2,
+                 gather_fn: Callable = _default_gather,
+                 shard_fn: Callable = _default_shard):
+        if max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {max_retries}")
+        self.injector = injector or FaultInjector()
+        self.tracer = tracer if tracer is not None \
+            else Tracer(enabled=False)
+        self.stats = stats if stats is not None \
+            else ServingStats(registry=self.tracer.registry)
+        self.max_retries = max_retries
+        self.gather_fn = gather_fn
+        self.shard_fn = shard_fn
+        self._extract = make_extract_pages_fn()
+        self._hot = {}
+
+    # -- per-replica labeled metrics ------------------------------------
+
+    def _counters(self, replica: str):
+        c = self._hot.get(replica)
+        if c is None:
+            r = self.tracer.registry
+            labels = {"replica": replica}
+            c = self._hot[replica] = (
+                r.counter("serving_transfer_src_bytes_total",
+                          help="page-handoff bytes shipped from this "
+                               "replica (verified payloads only)",
+                          labels=labels),
+                r.counter("serving_transfer_src_retries_total",
+                          help="handoff attempts retried against this "
+                               "replica", labels=labels),
+                r.counter("serving_transfer_src_failures_total",
+                          help="handoffs abandoned against this "
+                               "replica (budget exhausted)",
+                          labels=labels),
+                r.histogram("serving_transfer_ticks",
+                            buckets=TRANSFER_TICK_BUCKETS,
+                            help="deterministic tick cost charged per "
+                                 "delivered handoff",
+                            labels=labels),
+            )
+        return c
+
+    def observe_ticks(self, replica: str, ticks: int) -> None:
+        """Record the tick cost the router charged for a delivered
+        handoff (the clock side lives in the router — transfer only
+        prices it)."""
+        self._counters(replica)[3].observe(ticks)
+
+    # -- the handoff ----------------------------------------------------
+
+    def ship(self, src_engine, tokens: Sequence[int],
+             src_pages: Sequence[int], *, replica: str = "remote",
+             health=None) -> Tuple[Optional[np.ndarray],
+                                   Optional[np.ndarray], int]:
+        """Move ``src_pages`` (page ids in the SOURCE pool, in prompt
+        order) of the prompt ``tokens`` out of ``src_engine``'s cache,
+        verified: returns host ``(k_tile, v_tile, attempts)`` with the
+        tiles ready for :func:`make_insert_pages_fn` on the receiver
+        (``(None, None, attempts)`` for an empty batch — a fully-
+        deduped handoff still runs the control round-trip, so it can
+        still fault). ``attempts`` > 1 means retries happened; the
+        router prices each as one backoff tick on its work-charged
+        clock (deterministic backoff — no wall-clock sleeps in a
+        replay-exact scheduler). Raises :class:`TransferFailed` /
+        :class:`TransferCorrupt` when the retry budget is gone; every
+        attempt outcome feeds ``health`` (the remote replica's ladder)
+        when given."""
+        from apex_tpu.serving.paging import prefix_page_keys
+
+        inj = self.injector
+        trc = self.tracer
+        c_bytes, c_retries, c_failures, _ = self._counters(replica)
+        chain_key = prefix_page_keys(
+            [int(t) for t in tokens], src_engine.page_size)[-1]
+        n_pages = len(src_pages)
+        if trc.enabled:
+            trc.begin("page_transfer")
+        corrupt_last = False
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats.transfer_retries += 1
+                c_retries.inc()
+            if inj.fire("page_send"):
+                # the send was dropped before any bytes moved
+                if health is not None:
+                    health.probe(False)
+                continue
+            if n_pages:
+                k_tile, v_tile = self.gather_fn(*self._extract(
+                    src_engine.cache, jnp.asarray(src_pages, jnp.int32)))
+                digest = transfer_checksum(k_tile, v_tile, chain_key)
+                fired, payload = inj.draw("page_recv")
+                if fired:
+                    # in-flight corruption: flip one staged byte, the
+                    # payload picks which — deterministic per (seed,
+                    # site, index)
+                    k_tile = np.array(k_tile, copy=True)
+                    flat = k_tile.reshape(-1).view(np.uint8)
+                    flat[payload % flat.size] ^= 0xFF
+                if transfer_checksum(k_tile, v_tile,
+                                     chain_key) != digest:
+                    # quarantine: the tiles never reach the receiving
+                    # cache; retry re-extracts from the source of truth
+                    self.stats.transfer_corrupt += 1
+                    corrupt_last = True
+                    if health is not None:
+                        health.probe(False)
+                    continue
+                corrupt_last = False
+            else:
+                k_tile = v_tile = None
+                inj.draw("page_recv")  # handshake keeps draw order
+            self.stats.transfers += 1
+            if n_pages:
+                c_bytes.inc(int(k_tile.nbytes) + int(v_tile.nbytes))
+            if health is not None:
+                health.probe(True)
+            if trc.enabled:
+                trc.end("page_transfer", pages=n_pages,
+                        attempts=attempt + 1, replica=replica)
+            return k_tile, v_tile, attempt + 1
+        self.stats.transfer_failures += 1
+        c_failures.inc()
+        if trc.enabled:
+            trc.end("page_transfer", pages=n_pages,
+                    attempts=self.max_retries + 1, replica=replica,
+                    failed=True)
+        attempts = self.max_retries + 1
+        cls = TransferCorrupt if corrupt_last else TransferFailed
+        err = cls(
+            f"page handoff from replica {replica!r} lost all "
+            f"{attempts} attempts ({n_pages} pages"
+            f"{'; last payload corrupt' if corrupt_last else ''})",
+            attempts=attempts, pages=n_pages)
+        raise self.tracer.attach(err) if trc.enabled else err

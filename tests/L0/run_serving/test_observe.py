@@ -361,3 +361,64 @@ def test_perfetto_jsonl_dump_is_valid(model, tmp_path):
         if d["ph"] == "X":
             assert d["dur"] >= 1
     assert phs == {"X", "i"}  # spans and instants both present
+
+
+@pytest_chaos
+def test_disagg_transfer_metrics_and_replay(model):
+    """The disaggregated tier's observability surface: one
+    ``page_transfer`` span per handoff, per-replica LABELED transfer
+    counters, the replica health gauge, and the
+    ``serving_transfer_ticks`` histogram — all inside the event
+    taxonomy, and the whole tick-clock event stream replay-exact under
+    a pinned transfer fault."""
+    from apex_tpu.serving import DisaggregatedRouter
+    from apex_tpu.serving.health import HEALTH_STATES
+
+    def go():
+        inj = FaultInjector(schedule={"page_send": (0,)})
+        trc = Tracer()
+        router = DisaggregatedRouter(_engine(model, trc, inj),
+                                     _engine(model, trc, inj),
+                                     EOS, audit=True)
+        for s in range(3):
+            router.submit(Request(prompt=(7, 11, 13 + s),
+                                  max_new_tokens=6, temperature=0.7,
+                                  seed=s))
+        router.run()
+        return router, trc
+
+    router, trc = go()
+    names = {e.name for e in trc.events}
+    assert "page_transfer" in names
+    assert names <= set(PHASES) | set(LIFECYCLE)
+    spans = [e for e in trc.events if e.name == "page_transfer"]
+    assert len(spans) == router.stats.remote_prefills == 3
+    # the pinned send drop retried inside the FIRST span, delivered on
+    # attempt 2 — never a second span, never a failure
+    assert router.stats.transfer_retries == 1
+    assert router.stats.transfer_failures == 0
+    reg = trc.registry
+    labels = {"replica": "prefill"}
+    assert reg.get("serving_transfer_src_bytes_total",
+                   labels=labels).value > 0
+    assert reg.get("serving_transfer_src_retries_total",
+                   labels=labels).value == 1
+    assert reg.get("serving_transfer_src_failures_total",
+                   labels=labels).value == 0
+    hist = reg.get("serving_transfer_ticks", labels=labels)
+    assert hist.count == 3  # one charged tick cost per delivered handoff
+    # both replicas publish their health-state gauge; the one flaky
+    # probe recovered, so both sit at the top of the ladder
+    for replica in ("prefill", "decode"):
+        g = reg.get("serving_replica_health",
+                    labels={"replica": replica})
+        assert g.value == HEALTH_STATES.index("healthy")
+    # the stats view over the shared registry stays coherent: the
+    # engines and the router share ONE counter block
+    assert router.stats.registry is reg
+    assert reg.counter("serving_transfers_total").value \
+        == router.stats.transfers == 3
+    # replay-exactness: same seed, same schedule -> byte-equal
+    # tick-clock event stream, transfer spans included
+    _, trc2 = go()
+    assert trc.tick_stream() == trc2.tick_stream()

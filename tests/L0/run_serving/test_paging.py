@@ -5,6 +5,7 @@ appending into a shared page must never perturb the other request's
 logits (bit-identity, not tolerance)."""
 
 import dataclasses
+import struct
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,35 @@ def test_prefix_page_keys_chain():
     assert c[0] != a[0] and c[1] != a[1]
     with pytest.raises(ValueError, match="positive"):
         prefix_page_keys([1], 0)
+
+
+def test_prefix_page_key_encoding_is_pinned():
+    """The canonical byte layout under the chain hash — ``<II{n}i``
+    little-endian (version, count, tokens) — pinned by exact hex. The
+    chained digests are a CROSS-REPLICA wire format (prefix-cache
+    dedup, transfer checksums in the disaggregated tier), so any
+    drift here silently severs every cached prefix and quarantines
+    every in-flight handoff: a layout change must bump
+    ``PAGE_KEY_VERSION``, not mutate these vectors."""
+    from apex_tpu.serving.paging import PAGE_KEY_VERSION, _encode_page
+
+    assert PAGE_KEY_VERSION == 1
+    assert _encode_page((1, 2, 3, 4)).hex() == \
+        "010000000400000001000000020000000300000004000000"
+    assert [k.hex() for k in prefix_page_keys([1, 2, 3, 4, 5, 6, 7], 4)] \
+        == ["79e1a907696f5ad880df64ad64b10044647381ac2788c8f53e33ce"
+            "66f9f9a025",
+            "384380725a66cc2f73081861c743d7c658bc5bc5c3a40dbbed2e1e2"
+            "27c2ff961"]
+    # a partial page commits to its count: [0] under page_size 4 must
+    # not alias [0, 0] or a zero-padded full page
+    assert prefix_page_keys([0], 4)[0].hex() == \
+        "7d450465ceb49083708a6970827f0e0b116ed285072a95b451e55f583f56da8d"
+    assert prefix_page_keys(list(range(8)), 2)[-1].hex() == \
+        "68885af65c19be66af637a6cf362f02b6dc9c2c6ab3423a08c7600a81ccd0e86"
+    # int32 wire range is enforced, never truncated
+    with pytest.raises(struct.error):
+        _encode_page((2**31,))
 
 
 # -- PagePool ---------------------------------------------------------------
