@@ -759,6 +759,76 @@ def _page_handoff_medium_entry():
     return build
 
 
+def _page_spill_extract_medium_entry():
+    """r16 cost anchor: the sender half of a host-tier spill —
+    ``serving.transfer.make_extract_pages_fn`` gathering one full
+    prompt's tiles (8 pages x 64 tokens) out of the ragged medium pool
+    (32 slots, s_max 512, page 64, bf16) on their way to the
+    :class:`~apex_tpu.serving.paging.PrefixRegistry`. The gather
+    prices a spill at ~the page tile bytes, the same per-page unit the
+    r15 handoff pins — BASELINE r16 compares this against a decode
+    step's parameter read to justify ``promote_ticks_per_page``."""
+    def build():
+        import functools as ft
+
+        import jax
+
+        from apex_tpu.models.gpt import GPTConfig
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.transfer import make_extract_pages_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        n = s_max // page
+        fn = make_extract_pages_fn()
+        return fn, (cache, _sds((n,), "int32"))
+
+    return build
+
+
+def _page_promote_insert_quant_medium_entry():
+    """r16 cost anchor: a host-tier promotion into the INT8 pool —
+    ``serving.transfer.make_insert_pages_quant_fn`` scattering one
+    prompt's quantized tiles plus their per-page-per-head scale planes
+    back into HBM. The int8 payload is half the bf16 handoff's bytes
+    (the scale planes are noise: L x n x H fp32 values per side), which
+    is the capacity-doubling arithmetic BASELINE r16 banks for BOTH
+    tiers — the registry budgets bytes, so kv8 doubles its page count
+    exactly as it does HBM's."""
+    def build():
+        import functools as ft
+
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import GPTConfig
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.transfer import make_insert_pages_quant_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page,
+            jnp.int8))
+        n = s_max // page
+        tile = _sds((cfg.num_layers, n, cfg.num_heads, page,
+                     cfg.head_dim), "int8")
+        scale = _sds((cfg.num_layers, n, cfg.num_heads), "float32")
+        fn = make_insert_pages_quant_fn()
+        return fn, (cache, _sds((n,), "int32"), tile, tile, scale,
+                    scale)
+
+    return build
+
+
 def _paged_decode_step_entry(tp=None):
     """Paged decode: scatter the new row through the block table, then
     gather each slot's pages and attend (APX105 pins this file's
@@ -1395,6 +1465,18 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_page_handoff_medium",
                    "apex_tpu.serving.transfer",
                    _page_handoff_medium_entry(), checks=()),
+        # r16: the KV-cache hierarchy's two data movers at the same
+        # ragged medium shape — the spill-side page gather (bf16) and
+        # the promote-side quantized scatter (int8 + scale planes);
+        # budgets.json pins the per-page bytes a spill/promote moves,
+        # the denominator behind promote_ticks_per_page
+        TraceEntry("gpt_page_spill_extract_medium",
+                   "apex_tpu.serving.transfer",
+                   _page_spill_extract_medium_entry(), checks=()),
+        TraceEntry("gpt_page_promote_insert_quant_medium",
+                   "apex_tpu.serving.transfer",
+                   _page_promote_insert_quant_medium_entry(),
+                   checks=()),
         # r13: the model drafter's per-token forward at the medium
         # shape — the draft_bytes numerator of the break-even condition
         # (BASELINE.md r13); its hand-tightened ceiling pins the draft

@@ -10,7 +10,13 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   pool + per-slot block tables, K/V HBM proportional to allocated
   pages instead of ``slots x S_max``);
 - ``paging``    — host-side page allocator: free list, refcounts,
-  prefix-hash cache with LRU eviction, copy-on-write bookkeeping;
+  prefix-hash cache with LRU eviction, copy-on-write bookkeeping, and
+  the hierarchical KV-cache's host tier: a byte-budgeted
+  content-addressed ``PrefixRegistry`` that LRU-evicted sole-owned
+  prefix pages spill to (versioned checksum-bound ``SpillRecord``
+  wire format) and admission-time registry hits promote from —
+  shareable across engines and replicas so any replica's prefill
+  seeds everyone's cache;
 - ``decode``    — bucketed prefill + single-token decode + k+1-position
   speculative *verify* steps over either layout, an unsharded path and
   a TP-sharded path (heads over the ``model`` axis);
@@ -86,15 +92,17 @@ from apex_tpu.serving.faults import (  # noqa: F401
 from apex_tpu.serving.health import (  # noqa: F401
     FINISH_REASONS, HEALTH_STATES, AdmissionRejected, DeadlineExceeded,
     LivelockError, NonFiniteLogits, PoolExhausted, PoolInvariantError,
-    ReplicaHealth, ReplicaUnavailable, RequestOutcome,
-    RetryBudgetExhausted, ServingError, ServingStats, TransferCorrupt,
-    TransferFailed,
+    PromoteFailed, ReplicaHealth, ReplicaUnavailable, RequestOutcome,
+    RetryBudgetExhausted, ServingError, ServingStats, SpillFailed,
+    TransferCorrupt, TransferFailed,
 )
 from apex_tpu.serving.observe import (  # noqa: F401
     FlightRecorder, MetricsRegistry, TraceEvent, Tracer,
 )
 from apex_tpu.serving.paging import (  # noqa: F401
-    PAGE_KEY_VERSION, PagePool, prefix_page_keys,
+    PAGE_KEY_VERSION, SPILL_DTYPE_TAGS, PagePool, PrefixRegistry,
+    SpillRecord, decode_spill_header, encode_spill_header,
+    prefix_page_keys, spill_checksum,
 )
 from apex_tpu.serving.router import DisaggregatedRouter  # noqa: F401
 from apex_tpu.serving.sampling import (  # noqa: F401
@@ -105,6 +113,7 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
 )
 from apex_tpu.serving.transfer import (  # noqa: F401
-    PageTransfer, make_extract_pages_fn, make_insert_pages_fn,
+    PageTransfer, make_extract_pages_fn, make_extract_pages_quant_fn,
+    make_insert_pages_fn, make_insert_pages_quant_fn,
     make_tile_transfer_fns, transfer_checksum,
 )

@@ -66,6 +66,19 @@ executables stay fault-free):
                    (``serving.health.ReplicaHealth``); a down remote
                    stops receiving prefills, a down ACTIVE replica
                    triggers mid-stream failover
+``host_spill``     one HBM->host page spill is dropped before any bytes
+                   move (``PagedDecodeEngine._spill_page``, typed
+                   :class:`~apex_tpu.serving.health.SpillFailed`). The
+                   evicted prefix simply leaves both tiers — a later
+                   admission re-prefills it; nothing is retried and the
+                   committed streams are untouched
+``host_promote``   one host->HBM promotion fails mid-chain
+                   (``PagedDecodeEngine._promote_chain``, typed
+                   :class:`~apex_tpu.serving.health.PromoteFailed`).
+                   The admission degrades gracefully: pages promoted so
+                   far are kept, the remainder of the prompt is
+                   re-prefilled — the recovered stream is bit-identical
+                   to golden
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from
@@ -79,7 +92,7 @@ from typing import Dict, Iterable, Mapping, Optional, Tuple
 #: The named fault sites, in the order the docs list them.
 SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
          "decode_exec", "sample", "draft_exec", "page_send", "page_recv",
-         "replica_health")
+         "replica_health", "host_spill", "host_promote")
 
 
 class InjectedFault(RuntimeError):

@@ -54,6 +54,16 @@ Taxonomy (all subclass :class:`ServingError`):
                             state is ``down``, or its own page pool
                             refused the prompt — the router serves the
                             request colocated on the surviving engine
+:class:`SpillFailed`        an HBM→host page spill was dropped (the
+                            ``host_spill`` fault site, or a payload the
+                            host tier rejected); the evicted prefix
+                            leaves both tiers and a later admission
+                            re-prefills it — never retried, never fatal
+:class:`PromoteFailed`      a host→HBM promotion failed (fault, checksum
+                            mismatch, wrong-chain header, geometry
+                            drift); the stale host-tier entry is dropped
+                            and the admission degrades to re-prefilling
+                            the uncovered remainder of the prompt
 ==========================  ===============================================
 
 The disaggregated tier adds one piece of host-side *state* here too:
@@ -196,6 +206,37 @@ class ReplicaUnavailable(ServingError):
         self.payload.update(replica=replica)
 
 
+class SpillFailed(ServingError):
+    """An HBM→host page spill was dropped before the payload reached
+    the host tier (the ``host_spill`` fault site fired, or the
+    :class:`~apex_tpu.serving.paging.PrefixRegistry` rejected the
+    record). Purely a cache-efficiency loss: the evicted prefix simply
+    leaves both tiers and a later admission re-prefills it — the spill
+    path never retries and never fails a request."""
+
+    def __init__(self, msg: str, *, key: str = ""):
+        super().__init__(msg)
+        self.key = key
+        self.payload.update(key=key)
+
+
+class PromoteFailed(ServingError):
+    """A host→HBM page promotion failed verification or faulted: the
+    record's checksum did not recompute, its versioned header named a
+    different prompt chain or pool geometry, or the ``host_promote``
+    fault site fired. The stale host-tier entry is dropped (checksum /
+    header mismatches only) and the admission DEGRADES GRACEFULLY —
+    pages promoted so far are kept, the uncovered remainder of the
+    prompt re-prefills, and the committed stream stays bit-identical to
+    the spill-disabled scheduler."""
+
+    def __init__(self, msg: str, *, key: str = "", pages: int = 0):
+        super().__init__(msg)
+        self.key = key
+        self.pages = pages
+        self.payload.update(key=key, pages=pages)
+
+
 #: ``ReplicaHealth`` states, worst first. The index doubles as the
 #: ``serving_replica_health`` gauge value (0 = down .. 2 = healthy) so
 #: dashboards can alert on ``< 2`` without string labels.
@@ -304,6 +345,13 @@ STAT_FIELDS = {
     "transfer_corrupt": "handoff payloads quarantined on checksum",
     "transfer_failures": "handoffs abandoned (budget exhausted)",
     "failovers": "active-replica switches (slots drained + requeued)",
+    "host_spills": "pages spilled HBM->host on LRU eviction",
+    "host_spill_failures": "spills dropped (fault or tier rejection)",
+    "host_spill_bytes": "payload bytes spilled to the host tier",
+    "host_promotes": "pages promoted host->HBM on a prefix hit",
+    "host_promote_failures": "promotions abandoned (fault/verification)",
+    "host_promote_bytes": "payload bytes promoted from the host tier",
+    "host_promote_ticks": "tick-clock cost charged for promotions",
 }
 
 

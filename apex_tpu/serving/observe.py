@@ -54,10 +54,13 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 PHASES = ("draft", "prepare_decode", "exec", "accept", "commit",
           "chunk_prefill", "page_transfer")
 
-#: Per-request lifecycle instants.
+#: Per-request lifecycle instants. ``host_spill`` / ``host_promote``
+#: mark KV pages crossing the HBM <-> host-tier boundary (one instant
+#: per spilled page / per promoted chain, ``ok=False`` on a fault or
+#: verification failure).
 LIFECYCLE = ("submitted", "admitted", "prefill", "first_token",
              "preempted", "retried", "quarantined", "failover",
-             "finished")
+             "finished", "host_spill", "host_promote")
 
 #: Default histogram buckets for tick-denominated latencies (TTFT,
 #: inter-token). Roughly geometric: fine where SLOs live, coarse in
@@ -480,6 +483,26 @@ class Tracer:
             g_free.set(pool["free"])
             g_cached.set(pool["cached"])
             g_occ.set(pool["occupancy"])
+            if "host_pages" in pool:  # host-tier engines only
+                if "host" not in hot:
+                    r = self.registry
+                    hot["host"] = (
+                        r.gauge("serving_page_pool_hbm_used",
+                                help="HBM pages currently referenced"),
+                        r.gauge("serving_page_pool_host_pages",
+                                help="pages resident in the host spill "
+                                     "tier"),
+                        r.gauge("serving_page_pool_host_bytes",
+                                help="bytes resident in the host spill "
+                                     "tier (headers + payload + scales)"),
+                        r.gauge("serving_page_pool_host_hit_rate",
+                                help="host-tier registry hit rate since "
+                                     "start"))
+                g_hbm, g_hp, g_hb, g_hr = hot["host"]
+                g_hbm.set(pool["hbm_used"])
+                g_hp.set(pool["host_pages"])
+                g_hb.set(pool["host_bytes"])
+                g_hr.set(pool["host_hit_rate"])
 
     def latency_summary(self) -> Dict[str, float]:
         """``{ttft_p50: ..., itl_p99: ...}`` — flat quantile dict for

@@ -28,6 +28,20 @@ logical position lives in happens here, on the host, in plain Python:
   least-recently-used prefix-cache entries (releasing the registry's
   refs) until a page frees or the registry is empty; only then does it
   return ``None`` and the engine preempts.
+- **host spill tier** — a :class:`PrefixRegistry` (byte-budgeted,
+  LRU, shared across engines AND replicas) catches cold prefixes on
+  their way out: when the eviction sweep drops an entry whose page is
+  held ONLY by the registry (refcount 1 — never a page a slot still
+  attends), the pool's ``spill_hook`` copies the page's rows to host
+  memory as a :class:`SpillRecord` under the SAME chained content key.
+  A later admission that misses HBM but hits the host tier PROMOTES
+  the record back (``PagedDecodeEngine._promote_chain``): checksum +
+  versioned-header verification first (:func:`spill_checksum`,
+  :func:`encode_spill_header` — the transfer tier's checksum-bound
+  wire discipline), then a device scatter into freshly allocated
+  pages, priced on the work-charged tick clock like a disaggregated
+  handoff. int8 pools spill their per-page-per-head scales with the
+  payload, so the quantized format's 2x capacity holds in BOTH tiers.
 
 - **audit** — ``check_invariants()`` cross-checks refcounts against
   the free list, the prefix registry, and (given the engine's per-slot
@@ -44,7 +58,10 @@ is placement-invariant anyway (see ``_paged_decode_attention``).
 import hashlib
 import struct
 from collections import Counter, OrderedDict, deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
+
+import numpy as np
 
 from apex_tpu.serving.cache import RESERVED_PAGES
 from apex_tpu.serving.faults import FaultInjector
@@ -91,15 +108,241 @@ def prefix_page_keys(tokens: Sequence[int],
     return keys
 
 
+# ---------------------------------------------------------------------------
+# host spill tier: wire format + registry
+# ---------------------------------------------------------------------------
+
+#: Cache-dtype tags in the spill payload header. Append-only — like
+#: :data:`PAGE_KEY_VERSION` this is a wire format two tiers (and, via
+#: the shared registry, two replicas) must agree on.
+SPILL_DTYPE_TAGS = {"bfloat16": 1, "float32": 2, "float16": 3, "int8": 4}
+
+#: ``struct`` layout of the fixed spill-header prefix: version, layers,
+#: heads, page_size, head_dim, dtype tag — all little-endian uint32,
+#: followed by the 32-byte chained page key the payload belongs to.
+_SPILL_HEADER_FMT = "<IIIIII"
+SPILL_HEADER_BYTES = struct.calcsize(_SPILL_HEADER_FMT) + 32
+
+
+def encode_spill_header(key: bytes, num_layers: int, num_heads: int,
+                        page_size: int, head_dim: int,
+                        dtype_tag: int) -> bytes:
+    """Canonical versioned header bound into every spilled payload —
+    the same ``struct.pack`` wire-format discipline as
+    :func:`_encode_page`. It embeds the CHAINED page key, so a host-
+    tier record can only ever verify against the prompt chain that
+    produced it (the transfer tier's "payload can never install under
+    the wrong prompt" guarantee, extended to the spill tier), plus the
+    pool geometry and cache dtype so a record can never scatter into a
+    differently-shaped pool. The pinned-hex regression test freezes
+    this layout; changes bump :data:`PAGE_KEY_VERSION`."""
+    if len(key) != 32:
+        raise ValueError(
+            f"spill headers embed a 32-byte sha256 chain key, got "
+            f"{len(key)} bytes")
+    return struct.pack(_SPILL_HEADER_FMT, PAGE_KEY_VERSION, num_layers,
+                       num_heads, page_size, head_dim, dtype_tag) + key
+
+
+def decode_spill_header(header: bytes) -> Dict:
+    """Parse :func:`encode_spill_header` output; raises ``ValueError``
+    on a malformed length (content checks are the promoter's job)."""
+    if len(header) != SPILL_HEADER_BYTES:
+        raise ValueError(
+            f"spill header must be {SPILL_HEADER_BYTES} bytes, got "
+            f"{len(header)}")
+    version, layers, heads, page_size, head_dim, tag = struct.unpack(
+        _SPILL_HEADER_FMT, header[:-32])
+    return {"version": version, "num_layers": layers,
+            "num_heads": heads, "page_size": page_size,
+            "head_dim": head_dim, "dtype_tag": tag, "key": header[-32:]}
+
+
+def spill_checksum(header: bytes, k, v, k_scale=None,
+                   v_scale=None) -> bytes:
+    """sha256 over the header (which embeds the chain key — identity)
+    plus the staged tile bytes (integrity), the exact shape of
+    ``transfer.transfer_checksum`` with the scale planes of an int8
+    page folded in. Recomputed before every promotion; a mismatch
+    quarantines the record (dropped, never installed)."""
+    h = hashlib.sha256()
+    h.update(header)
+    h.update(np.ascontiguousarray(k).tobytes())
+    h.update(np.ascontiguousarray(v).tobytes())
+    if k_scale is not None:
+        h.update(np.ascontiguousarray(k_scale).tobytes())
+        h.update(np.ascontiguousarray(v_scale).tobytes())
+    return h.digest()
+
+
+class SpillRecord(NamedTuple):
+    """One spilled page in host memory: the versioned header, the
+    page's K/V tiles as host arrays ``(layers, 1, heads, page_size,
+    head_dim)``, the int8 pool's per-page-per-head scale planes
+    ``(layers, 1, heads)`` (``None`` for float pools — they must
+    travel together or the page dequantizes wrong), and the
+    :func:`spill_checksum` digest computed at spill time."""
+
+    header: bytes
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: Optional[np.ndarray]
+    v_scale: Optional[np.ndarray]
+    digest: bytes
+
+    @property
+    def nbytes(self) -> int:
+        n = len(self.header) + self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+class PrefixRegistry:
+    """The host-memory spill tier: a byte-budgeted LRU map from
+    chained prefix page keys to :class:`SpillRecord` payloads. ONE
+    instance is shared by every engine (and both replicas of a
+    :class:`~apex_tpu.serving.router.DisaggregatedRouter`) — the keys
+    are a global content address, so any replica's prefill seeds
+    everyone's cache and a promotion never cares which pool spilled
+    the bytes.
+
+    Capacity is measured in BYTES, not pages, deliberately: an int8
+    pool's records are roughly half a bf16 pool's, so KV quantization
+    doubles the effective capacity of this tier exactly as it does
+    HBM's. Eviction is LRU by insertion/refresh order; ``get`` hits
+    refresh recency and feed the hit-rate gauge. Deterministic host
+    state: no RNG, no clocks — identical request streams replay
+    identical spill/promote decisions (and APX401-style discipline
+    applies: never read from traced code)."""
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be positive, got {capacity_bytes}")
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: "OrderedDict[bytes, SpillRecord]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    @property
+    def num_pages(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    @property
+    def hit_rate(self) -> float:
+        probes = self.hits + self.misses
+        return self.hits / probes if probes else 0.0
+
+    def put(self, key: bytes, record: SpillRecord) -> bool:
+        """Admit one spilled page; False when deduped (already held —
+        only LRU-refreshed) or rejected (a single record over the whole
+        byte budget). Admission may LRU-evict colder records to fit."""
+        if record.header[-32:] != key:
+            raise ValueError(
+                "spill record header embeds a different chain key than "
+                "it is being registered under")
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        if record.nbytes > self.capacity_bytes:
+            self.rejected += 1
+            return False
+        self._entries[key] = record
+        self._bytes += record.nbytes
+        while self._bytes > self.capacity_bytes:
+            _, old = self._entries.popitem(last=False)
+            self._bytes -= old.nbytes
+            self.evictions += 1
+        return True
+
+    def get(self, key: bytes) -> Optional[SpillRecord]:
+        """Look one key up, refreshing recency on a hit. Promotion-path
+        verification (checksum, header) is the caller's job — the
+        registry only answers "do I hold these bytes"."""
+        rec = self._entries.get(key)
+        if rec is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return rec
+
+    def drop(self, key: bytes) -> bool:
+        """Evict one record (failed verification, explicit
+        invalidation); False when absent."""
+        rec = self._entries.pop(key, None)
+        if rec is None:
+            return False
+        self._bytes -= rec.nbytes
+        return True
+
+    def stats(self) -> Dict:
+        """``host_*``-prefixed gauge sources, merged into
+        :meth:`PagePool.stats` per-tier breakdowns."""
+        return {"host_pages": self.num_pages,
+                "host_bytes": self._bytes,
+                "host_capacity_bytes": self.capacity_bytes,
+                "host_hits": self.hits,
+                "host_misses": self.misses,
+                "host_hit_rate": self.hit_rate,
+                "host_evictions": self.evictions}
+
+    def check_invariants(self) -> bool:
+        """Audit the tier's books: byte accounting exact, budget
+        respected, every record keyed consistently with its embedded
+        header key, every digest recomputing. Raises
+        :class:`~apex_tpu.serving.health.PoolInvariantError`; folded
+        into ``PagePool.check_invariants`` (the per-tick chaos audit)
+        when the pool carries a host tier."""
+        total = sum(r.nbytes for r in self._entries.values())
+        if total != self._bytes:
+            raise PoolInvariantError(
+                f"host tier byte accounting drifted: tracked "
+                f"{self._bytes}, actual {total}")
+        if self._bytes > self.capacity_bytes:
+            raise PoolInvariantError(
+                f"host tier over budget: {self._bytes} > "
+                f"{self.capacity_bytes}")
+        for key, rec in self._entries.items():
+            if rec.header[-32:] != key:
+                raise PoolInvariantError(
+                    f"host tier record {key.hex()[:12]} embeds a "
+                    "different chain key in its header")
+            if spill_checksum(rec.header, rec.k, rec.v, rec.k_scale,
+                              rec.v_scale) != rec.digest:
+                raise PoolInvariantError(
+                    f"host tier record {key.hex()[:12]} fails its "
+                    "spill checksum")
+        return True
+
+
 class PagePool:
     """Free list + per-page refcounts + LRU prefix registry (see
     module doc). ``free_order`` overrides the initial free-list order —
     the placement bit-identity tests admit the same requests through
-    permuted orders and require identical logits."""
+    permuted orders and require identical logits. ``host_tier`` hangs
+    a shared :class:`PrefixRegistry` under the pool; the owning engine
+    installs ``spill_hook`` so the eviction sweep can copy out
+    sole-registry-owned pages before releasing them."""
 
     def __init__(self, num_pages: int, page_size: int,
                  free_order: Optional[Sequence[int]] = None,
-                 injector: Optional[FaultInjector] = None):
+                 injector: Optional[FaultInjector] = None,
+                 host_tier: Optional[PrefixRegistry] = None):
         if page_size < 1:
             raise ValueError(f"page_size must be positive, got {page_size}")
         if num_pages <= RESERVED_PAGES:
@@ -122,6 +365,11 @@ class PagePool:
         # chained prefix key -> page holding that page's rows; each
         # entry owns one reference on its page; insertion order = LRU
         self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+        # the host spill tier (shared across pools) and the engine's
+        # spill callback ``(key, page) -> None`` — consulted by the
+        # eviction sweep ONLY for pages the registry solely owns
+        self.host_tier = host_tier
+        self.spill_hook: Optional[Callable[[bytes, int], None]] = None
 
     # -- refcounting ------------------------------------------------------
 
@@ -163,6 +411,14 @@ class PagePool:
             return None
         while not self._free and self._prefix:
             key, page = self._prefix.popitem(last=False)
+            # spill on the way out — but NEVER a page a slot still
+            # attends (refcount > 1): only the registry's sole
+            # reference guarantees the rows are the pristine
+            # registered prefix (COW protects shared pages from
+            # mutation, and an attended page keeps serving from HBM)
+            if self.spill_hook is not None \
+                    and self._ref.get(page, 0) == 1:
+                self.spill_hook(key, page)
             self.release(page)
         if not self._free:
             return None
@@ -277,6 +533,8 @@ class PagePool:
                 raise PoolInvariantError(
                     f"page {page}: {n} registry entries but refcount "
                     f"{self._ref.get(page, 0)}")
+        if self.host_tier is not None:
+            self.host_tier.check_invariants()
         if slot_pages is not None:
             expected = Counter(registry)
             for slot, pages in enumerate(slot_pages):
@@ -295,10 +553,27 @@ class PagePool:
                     f"registry refs vs actual): {diff}")
         return True
 
+    def stats(self) -> Dict:
+        """Per-tier breakdown for gauges and bench ``extra`` blocks:
+        the HBM side (usable/free/cached/used pages, occupancy) plus,
+        when a host tier is attached, its ``host_*``-prefixed stats
+        (:meth:`PrefixRegistry.stats`)."""
+        s = {"hbm_pages": self.num_usable,
+             "hbm_free": self.num_free,
+             "hbm_cached": self.num_cached,
+             "hbm_used": self.num_usable - self.num_free,
+             "occupancy": self.occupancy}
+        if self.host_tier is not None:
+            s.update(self.host_tier.stats())
+        return s
+
     def snapshot(self) -> Dict:
         """Plain-dict view of the allocator state for diagnostics
         (:class:`~apex_tpu.serving.health.LivelockError` payloads)."""
-        return {"num_free": self.num_free,
+        snap = {"num_free": self.num_free,
                 "num_cached": self.num_cached,
                 "occupancy": self.occupancy,
                 "refcounts": dict(self._ref)}
+        if self.host_tier is not None:
+            snap["host_tier"] = self.host_tier.stats()
+        return snap

@@ -125,6 +125,13 @@ def _validate_replicas(prefill_engine, decode_engine) -> None:
                  "spec_k", "top_k", "top_p", "adaptive_spec",
                  "prefix_sharing"):
         _require_same(prefill_engine, decode_engine, attr)
+    if prefill_engine.host_tier is not decode_engine.host_tier:
+        raise ValueError(
+            "both replicas must share ONE PrefixRegistry host tier "
+            "(or neither): the registry is the global content-"
+            "addressed map — split tiers would fork the prefix "
+            "namespace (construct both engines with the same "
+            "host_tier=)")
     if prefill_engine.injector is not decode_engine.injector:
         raise ValueError(
             "both replicas must share ONE FaultInjector: fault draws "
@@ -214,8 +221,13 @@ class _DisaggEngine:
     # -- admission-charge handshake with the router ---------------------
 
     def pop_admit_charge(self, default: int) -> int:
+        # a remote prefill staged its handoff (+ promote) cost here; a
+        # colocated one staged on the active engine — delegate so its
+        # host-tier repricing (suffix depth + promote ticks) survives
         charge, self._admit_charge = self._admit_charge, None
-        return default if charge is None else charge
+        if charge is not None:
+            return charge
+        return self.active.pop_admit_charge(default)
 
     # -- routed prefill -------------------------------------------------
 
@@ -257,18 +269,29 @@ class _DisaggEngine:
             # the replica down the ladder toward colocated routing
             rhealth.probe(False)
             raise
+        # the remote prefill staged its OWN admission repricing (it may
+        # carry a host tier); the router charges handoff ticks instead
+        rem.pop_admit_charge(0)
         # allocate the destination pages in the SAME order a colocated
-        # prefill would: longest registered prefix run shared, the
-        # remainder fresh from the active pool
+        # prefill would: longest registered prefix run shared, host-
+        # tier promotions extending it, the remainder fresh from the
+        # active pool
         keys = prefix_page_keys(toks, act.page_size)
         n_pages = max_pages_per_slot(len(toks), act.page_size)
         shared = act.pool.match_prefix(keys) if act.prefix_sharing \
             else []
+        promoted: List[int] = []
+        promote_ticks = 0
+        if act.host_tier is not None and act.prefix_sharing \
+                and len(shared) < n_pages:
+            promoted, promote_ticks = act._promote_chain(
+                keys, len(shared))
+        covered = len(shared) + len(promoted)
         private: List[int] = []
-        for _ in range(n_pages - len(shared)):
+        for _ in range(n_pages - covered):
             p = act.pool.alloc()
             if p is None:
-                for q in shared + private:
+                for q in shared + promoted + private:
                     act.pool.release(q)
                 rem.free_slot(_STAGING_SLOT)
                 raise PoolExhausted(
@@ -277,18 +300,18 @@ class _DisaggEngine:
                     "evict", need=n_pages, free=act.pool.num_free,
                     cached=act.pool.num_cached)
             private.append(p)
-        src_pages = rem._slot_pages[_STAGING_SLOT][len(shared):n_pages]
-        self.stats.transfer_pages_deduped += len(shared)
+        src_pages = rem._slot_pages[_STAGING_SLOT][covered:n_pages]
+        self.stats.transfer_pages_deduped += covered
         try:
             k_tile, v_tile, attempts = self.transfer.ship(
                 rem, toks, src_pages, replica=self._remote_name,
                 health=rhealth)
         except (TransferFailed, TransferCorrupt):
-            for q in shared + private:
+            for q in shared + promoted + private:
                 act.pool.release(q)
             rem.free_slot(_STAGING_SLOT)
             raise
-        pages = shared + private
+        pages = shared + promoted + private
         row = np.full((act.max_pages,), NULL_PAGE, np.int32)
         row[:n_pages] = pages
         # install: block-table row + true prompt length (exactly what
@@ -309,7 +332,8 @@ class _DisaggEngine:
             act.pool.register_prefix(keys, pages)
         rem.free_slot(_STAGING_SLOT)
         self.stats.remote_prefills += 1
-        ticks = self._handoff_ticks(len(private), attempts)
+        ticks = self._handoff_ticks(len(private), attempts) \
+            + promote_ticks
         self._admit_charge = ticks
         self.transfer.observe_ticks(self._remote_name, ticks)
         # the logits hop replicas with the pages (a 1 x vocab row —
@@ -390,13 +414,6 @@ class DisaggregatedRouter(ContinuousBatchingScheduler):
     @property
     def health(self) -> Dict[str, ReplicaHealth]:
         return self.engine.health
-
-    def _charge_work(self, tokens: int) -> None:
-        # a remote prefill left its handoff cost with the adapter; a
-        # colocated one charges its sequential depth like the base
-        # scheduler (the remote forward overlaps decode — that gap is
-        # the disaggregation win)
-        super()._charge_work(self.engine.pop_admit_charge(tokens))
 
     def _admit(self) -> None:
         eng = self.engine

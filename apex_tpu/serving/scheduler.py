@@ -144,11 +144,20 @@ from apex_tpu.serving.draft import ngram_draft, tree_arrays
 from apex_tpu.serving.faults import FaultInjector, InjectedFault
 from apex_tpu.serving.health import (
     AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
-    PoolExhausted, RequestOutcome, RetryBudgetExhausted, ServingStats,
+    PoolExhausted, PromoteFailed, RequestOutcome, RetryBudgetExhausted,
+    ServingStats, SpillFailed,
 )
 from apex_tpu.quant.params import is_quantized_tree
 from apex_tpu.serving.observe import Tracer
-from apex_tpu.serving.paging import PagePool, prefix_page_keys
+from apex_tpu.serving.paging import (
+    PAGE_KEY_VERSION, SPILL_DTYPE_TAGS, PagePool, PrefixRegistry,
+    SpillRecord, decode_spill_header, encode_spill_header,
+    prefix_page_keys, spill_checksum,
+)
+from apex_tpu.serving.transfer import (
+    make_extract_pages_fn, make_extract_pages_quant_fn,
+    make_insert_pages_fn, make_insert_pages_quant_fn,
+)
 from apex_tpu.serving.sampling import (
     finite_rows, sample_token_grid, sample_tokens,
     tree_speculative_accept,
@@ -547,6 +556,18 @@ class DecodeEngine:
         (``None``: the dense cache has no page pool to meter)."""
         return None
 
+    def pop_admit_charge(self, default: int) -> int:
+        """Tick-clock cost of the admission/prefill forward the
+        scheduler just ran — consumed (and reset) by
+        ``ContinuousBatchingScheduler._charge_work``. The base engine
+        charges the ``default`` (the forward's sequential depth);
+        engines that replaced part of that depth with cheaper work
+        stage a different charge here: a host-tier promotion prices
+        the skipped prefix at transfer ticks, and the disaggregated
+        composite prices a remote prefill at handoff ticks. Purely
+        accounting — sampling keys never see the clock."""
+        return default
+
 
 class PagedDecodeEngine(DecodeEngine):
     """:class:`DecodeEngine` over the paged cache: a fixed page pool,
@@ -577,7 +598,9 @@ class PagedDecodeEngine(DecodeEngine):
                  injector: Optional[FaultInjector] = None,
                  draft_model=None, tree_spec: bool = False,
                  adaptive_spec: bool = False,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 host_tier: Optional[PrefixRegistry] = None,
+                 promote_ticks_per_page: float = 0.125):
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
@@ -618,7 +641,33 @@ class PagedDecodeEngine(DecodeEngine):
         self.cache = init_paged_cache(cfg, num_slots, max_len, num_pages,
                                       page_size, cache_dtype)
         self.pool = PagePool(num_pages, page_size, free_order,
-                             injector=self.injector)
+                             injector=self.injector,
+                             host_tier=host_tier)
+        # host spill tier (see serving.paging): the pool's eviction
+        # sweep calls _spill_page for sole-registry-owned pages; a
+        # prefix-registry hit at admission promotes records back via
+        # _promote_chain. The staged admission charge reprices the
+        # monolithic prefill's sequential depth at (suffix depth +
+        # promote ticks) — pure clock accounting, streams untouched.
+        self.host_tier = host_tier
+        self.promote_ticks_per_page = float(promote_ticks_per_page)
+        self._admit_charge: Optional[int] = None
+        self._admit_extra = 0
+        if host_tier is not None:
+            quant = jnp.dtype(cache_dtype) == jnp.int8
+            name = jnp.dtype(cache_dtype).name
+            if name not in SPILL_DTYPE_TAGS:
+                raise ValueError(
+                    f"cache dtype {name!r} has no spill wire tag; the "
+                    f"host tier speaks {sorted(SPILL_DTYPE_TAGS)}")
+            self._spill_geometry = (cfg.num_layers, cfg.num_heads,
+                                    page_size, cfg.head_dim,
+                                    SPILL_DTYPE_TAGS[name])
+            self._tier_extract = (make_extract_pages_quant_fn()
+                                  if quant else make_extract_pages_fn())
+            self._tier_insert = (make_insert_pages_quant_fn()
+                                 if quant else make_insert_pages_fn())
+            self.pool.spill_hook = self._spill_page
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
         # slots mid-chunked-prefill: their device block-table row is
         # parked on scratch (see begin_chunk_prefill), so the audit
@@ -668,11 +717,18 @@ class PagedDecodeEngine(DecodeEngine):
         keys = prefix_page_keys(toks, self.page_size)
         shared = self.pool.match_prefix(keys) if self.prefix_sharing \
             else []
+        promoted: List[int] = []
+        promote_ticks = 0
+        if self.host_tier is not None and self.prefix_sharing \
+                and len(shared) < n_pages:
+            promoted, promote_ticks = self._promote_chain(
+                keys, len(shared))
+        covered = len(shared) + len(promoted)
         private: List[int] = []
-        for _ in range(n_pages - len(shared)):
+        for _ in range(n_pages - covered):
             p = self.pool.alloc()
             if p is None:
-                for q in shared + private:
+                for q in shared + promoted + private:
                     self.pool.release(q)
                 raise PoolExhausted(
                     f"prompt needs {n_pages} pages; pool has "
@@ -680,7 +736,7 @@ class PagedDecodeEngine(DecodeEngine):
                     "evict", need=n_pages, free=self.pool.num_free,
                     cached=self.pool.num_cached)
             private.append(p)
-        pages = shared + private
+        pages = shared + promoted + private
         fired, _ = self.injector.draw("prefill_exec")
         if fired:
             for q in pages:
@@ -689,24 +745,57 @@ class PagedDecodeEngine(DecodeEngine):
                                 self.injector.calls("prefill_exec") - 1)
         self._slot_pages[slot] = list(pages)
 
-        ids = np.asarray(toks, np.int32)[None, :]
-        ids, mask = pad_to_bucket(ids, ids.shape[1], buckets=self.buckets)
-        write = np.full((ids.shape[1] // self.page_size,), SCRATCH_PAGE,
-                        np.int32)
-        write[len(shared):n_pages] = private
         row = np.full((self.max_pages,), NULL_PAGE, np.int32)
         row[:n_pages] = pages
+        # a host-tier engine skips fully-covered leading pages the way
+        # chunked prefill does: the suffix runs as one final "chunk"
+        # whose attention gathers the covered pages through the real
+        # row — that sequential-depth saving is the promotion's whole
+        # TTFT win. The int8 pool keeps the monolithic forward (the
+        # chunk core refuses it); its covered pages are still reused
+        # verbatim by decode, exactly like HBM-shared ones.
+        skip = 0
+        if self.host_tier is not None and covered \
+                and self.cache.k_scale is None:
+            skip = min(covered, max(n_pages - 1, 0))
+        start = skip * self.page_size
         trc = self.tracer
         if trc.enabled:
             trc.begin("prefill")
-        self.cache, logits = self._prefill(
-            self.params, self.cache, ids, mask, jnp.int32(slot),
-            jnp.asarray(write), jnp.asarray(row))
+        if skip:
+            ids = np.asarray(toks[start:], np.int32)[None, :]
+            ids, mask = pad_to_bucket(ids, ids.shape[1],
+                                      buckets=self.buckets)
+            write = np.full((ids.shape[1] // self.page_size,),
+                            SCRATCH_PAGE, np.int32)
+            for j in range(write.shape[0]):
+                ai = skip + j
+                if covered <= ai < n_pages:
+                    write[j] = pages[ai]
+            self.cache, logits = self._chunk_prefill(
+                self.params, self.cache, ids, mask, jnp.int32(slot),
+                jnp.int32(start), jnp.asarray(write), jnp.asarray(row),
+                jnp.asarray(row))
+        else:
+            ids = np.asarray(toks, np.int32)[None, :]
+            ids, mask = pad_to_bucket(ids, ids.shape[1],
+                                      buckets=self.buckets)
+            write = np.full((ids.shape[1] // self.page_size,),
+                            SCRATCH_PAGE, np.int32)
+            write[covered:n_pages] = private
+            self.cache, logits = self._prefill(
+                self.params, self.cache, ids, mask, jnp.int32(slot),
+                jnp.asarray(write), jnp.asarray(row))
         if trc.enabled:
             trc.end("prefill", slot=slot, bucket=int(ids.shape[1]),
-                    shared_pages=len(shared))
+                    shared_pages=covered)
         if self.prefix_sharing:
             self.pool.register_prefix(keys, pages)
+        if self.host_tier is not None:
+            # reprice the admission: the forward only ran the suffix's
+            # depth, and each promotion costs transfer ticks (the same
+            # pop_admit_charge handshake the disagg handoff uses)
+            self._admit_charge = (len(toks) - start) + promote_ticks
         return logits
 
     # -- chunked prefill ------------------------------------------------
@@ -734,11 +823,18 @@ class PagedDecodeEngine(DecodeEngine):
         keys = prefix_page_keys(toks, self.page_size)
         shared = self.pool.match_prefix(keys) if self.prefix_sharing \
             else []
+        promoted: List[int] = []
+        promote_ticks = 0
+        if self.host_tier is not None and self.prefix_sharing \
+                and len(shared) < n_pages:
+            promoted, promote_ticks = self._promote_chain(
+                keys, len(shared))
+        covered = len(shared) + len(promoted)
         private: List[int] = []
-        for _ in range(n_pages - len(shared)):
+        for _ in range(n_pages - covered):
             p = self.pool.alloc()
             if p is None:
-                for q in shared + private:
+                for q in shared + promoted + private:
                     self.pool.release(q)
                 raise PoolExhausted(
                     f"prompt needs {n_pages} pages; pool has "
@@ -746,13 +842,18 @@ class PagedDecodeEngine(DecodeEngine):
                     "evict", need=n_pages, free=self.pool.num_free,
                     cached=self.pool.num_cached)
             private.append(p)
-        pages = shared + private
+        pages = shared + promoted + private
         self._slot_pages[slot] = list(pages)
         self._prefill_parked.add(slot)
+        if promote_ticks:
+            # promotions cost transfer ticks; chunked admission charges
+            # per chunk, so the extra rides the next pop (additively —
+            # several staged prefills may promote before one pops)
+            self._admit_extra += promote_ticks
         row = np.full((self.max_pages,), NULL_PAGE, np.int32)
         row[:n_pages] = pages
-        skip = min(len(shared), max(n_pages - 1, 0))
-        return {"keys": keys, "pages": pages, "shared": len(shared),
+        skip = min(covered, max(n_pages - 1, 0))
+        return {"keys": keys, "pages": pages, "shared": covered,
                 "n_pages": n_pages, "row": row,
                 "start": skip * self.page_size}
 
@@ -805,6 +906,130 @@ class PagedDecodeEngine(DecodeEngine):
         admissions — the same registration monolithic prefill does."""
         if self.prefix_sharing:
             self.pool.register_prefix(state["keys"], state["pages"])
+
+    def pop_admit_charge(self, default: int) -> int:
+        """Pop the staged admission charge (see base class). A
+        host-tier prefill stages an ABSOLUTE charge (suffix depth +
+        promote ticks); chunked admissions accumulate promote ticks
+        ADDITIVELY on top of the per-chunk default."""
+        charge, self._admit_charge = self._admit_charge, None
+        extra, self._admit_extra = self._admit_extra, 0
+        return (default if charge is None else charge) + extra
+
+    def _spill_page(self, key: bytes, page: int) -> None:
+        """Pool eviction hook: copy ``page`` (sole-owned by the prefix
+        registry, so its content is pristine — COW guarantees no slot
+        ever appended to it) out to the host tier under its chain key.
+        A fired ``host_spill`` site drops the spill on the floor: the
+        prefix simply leaves both tiers and a later admission
+        re-prefills it — graceful, nothing retried."""
+        fired, _ = self.injector.draw("host_spill")
+        if fired:
+            self.stats.host_spill_failures += 1
+            if self.tracer.enabled:
+                self.tracer.instant("host_spill", page=page, ok=False)
+            return
+        ids = jnp.asarray([page], jnp.int32)
+        tiles = self._tier_extract(self.cache, ids)
+        if len(tiles) == 4:
+            k, v, ks, vs = (np.asarray(t) for t in tiles)
+        else:
+            k, v = (np.asarray(t) for t in tiles)
+            ks = vs = None
+        header = encode_spill_header(key, *self._spill_geometry)
+        rec = SpillRecord(header, k, v, ks, vs,
+                          spill_checksum(header, k, v, ks, vs))
+        if self.host_tier.put(key, rec):
+            self.stats.host_spills += 1
+            self.stats.host_spill_bytes += rec.nbytes
+            if self.tracer.enabled:
+                self.tracer.instant("host_spill", page=page,
+                                    bytes=rec.nbytes)
+
+    def _verify_spill(self, key: bytes, rec: SpillRecord) -> None:
+        """Checksum + header verification for a promoted record — the
+        same trust boundary the cross-replica page handoff enforces.
+        Raises :class:`PromoteFailed` on any mismatch."""
+        digest = spill_checksum(rec.header, rec.k, rec.v,
+                                rec.k_scale, rec.v_scale)
+        if digest != rec.digest:
+            raise PromoteFailed(
+                f"spill record checksum mismatch for {key.hex()[:16]}",
+                key=key.hex())
+        hdr = decode_spill_header(rec.header)
+        if hdr["key"] != key:
+            raise PromoteFailed(
+                f"spill header bound to {hdr['key'].hex()[:16]} but "
+                f"registered under {key.hex()[:16]}", key=key.hex())
+        geom = (hdr["num_layers"], hdr["num_heads"], hdr["page_size"],
+                hdr["head_dim"], hdr["dtype_tag"])
+        if hdr["version"] != PAGE_KEY_VERSION \
+                or geom != self._spill_geometry:
+            raise PromoteFailed(
+                f"spill geometry {hdr} does not match this engine",
+                key=key.hex())
+
+    def _promote_chain(self, keys: List[bytes],
+                       start: int) -> Tuple[List[int], int]:
+        """Extend an HBM prefix match by promoting consecutive chain
+        links from the host tier: for each key past the HBM-shared run,
+        verify the registry record, allocate an HBM page and batch-copy
+        the payload back in. The chain breaks at the first miss, fired
+        ``host_promote`` site, verification failure (the stale record
+        is dropped), or pool exhaustion — pages promoted so far are
+        kept and the remainder of the prompt re-prefills. Returns
+        ``(pages, ticks)``; the caller owns one reference per page and
+        must charge ``ticks`` on the work clock."""
+        pages: List[int] = []
+        records: List[SpillRecord] = []
+        failed: Optional[PromoteFailed] = None
+        for key in keys[start:]:
+            rec = self.host_tier.get(key)
+            if rec is None:
+                break
+            fired, _ = self.injector.draw("host_promote")
+            if fired:
+                failed = PromoteFailed(
+                    "injected host_promote fault", key=key.hex(),
+                    pages=len(pages))
+                break
+            try:
+                self._verify_spill(key, rec)
+            except PromoteFailed as e:
+                self.host_tier.drop(key)
+                failed = e
+                break
+            p = self.pool.alloc()
+            if p is None:
+                break
+            pages.append(p)
+            records.append(rec)
+        if failed is not None:
+            self.stats.host_promote_failures += 1
+            if self.tracer.enabled:
+                self.tracer.instant("host_promote", ok=False,
+                                    pages=len(pages))
+        if not pages:
+            return [], 0
+        ids = jnp.asarray(pages, jnp.int32)
+        k = np.concatenate([r.k for r in records], axis=1)
+        v = np.concatenate([r.v for r in records], axis=1)
+        if records[0].k_scale is not None:
+            ks = np.concatenate([r.k_scale for r in records], axis=1)
+            vs = np.concatenate([r.v_scale for r in records], axis=1)
+            self.cache = self._tier_insert(self.cache, ids, k, v, ks, vs)
+        else:
+            self.cache = self._tier_insert(self.cache, ids, k, v)
+        ticks = max(1, int(np.ceil(
+            len(pages) * self.promote_ticks_per_page)))
+        nbytes = sum(r.nbytes for r in records)
+        self.stats.host_promotes += len(pages)
+        self.stats.host_promote_bytes += nbytes
+        self.stats.host_promote_ticks += ticks
+        if self.tracer.enabled:
+            self.tracer.instant("host_promote", pages=len(pages),
+                                bytes=nbytes, ticks=ticks)
+        return pages, ticks
 
     def prepare_decode(self, positions: Dict[int, int],
                        n_new: int = 1) -> List[int]:
@@ -903,9 +1128,16 @@ class PagedDecodeEngine(DecodeEngine):
         return snap
 
     def pool_gauges(self) -> Dict[str, float]:
-        return {"free": self.pool.num_free,
-                "cached": self.pool.num_cached,
-                "occupancy": self.pool.occupancy}
+        gauges = {"free": self.pool.num_free,
+                  "cached": self.pool.num_cached,
+                  "occupancy": self.pool.occupancy}
+        if self.host_tier is not None:
+            stats = self.pool.stats()
+            gauges["hbm_used"] = stats["hbm_used"]
+            gauges["host_pages"] = stats["host_pages"]
+            gauges["host_bytes"] = stats["host_bytes"]
+            gauges["host_hit_rate"] = stats["host_hit_rate"]
+        return gauges
 
 
 class ContinuousBatchingScheduler:
@@ -1091,7 +1323,12 @@ class ContinuousBatchingScheduler:
         while chunked prefill bounds the gap at the tick token
         budget. Purely an accounting change: sampling keys fold in
         token counts, never ticks, so committed streams are
-        untouched."""
+        untouched. The engine may reprice the charge via
+        :meth:`DecodeEngine.pop_admit_charge` — a host-tier promote
+        shrinks the forward to the suffix depth but adds transfer
+        ticks, and the disaggregated router charges handoff ticks the
+        same way."""
+        tokens = self.engine.pop_admit_charge(tokens)
         if tokens > 1:
             self._tick_no += tokens - 1
             if self.tracer.enabled:

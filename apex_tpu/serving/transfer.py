@@ -96,6 +96,42 @@ def make_insert_pages_fn() -> Callable:
     return jax.jit(insert, donate_argnums=(0,))
 
 
+def make_extract_pages_quant_fn() -> Callable:
+    """:func:`make_extract_pages_fn` for the int8 pool: gathers the
+    per-page-per-head fp32 scale planes ``(layers, n_pages, heads)``
+    TOGETHER with the int8 tiles — ``(cache, page_ids) -> (k_tile,
+    v_tile, k_scale, v_scale)``. A page's rows are meaningless without
+    the scales they were quantized against, so the spill/promote wire
+    payload always carries all four (and still comes out at roughly
+    half a bf16 payload's bytes — the capacity argument for the int8
+    host tier)."""
+
+    def extract(cache, page_ids):
+        return (cache.k[:, page_ids], cache.v[:, page_ids],
+                cache.k_scale[:, page_ids], cache.v_scale[:, page_ids])
+
+    return jax.jit(extract)
+
+
+def make_insert_pages_quant_fn() -> Callable:
+    """:func:`make_insert_pages_fn` for the int8 pool: scatters int8
+    tiles AND their fp32 scale planes into the identified pages —
+    ``(cache, page_ids, k_tile, v_tile, k_scale, v_scale) -> cache``,
+    cache donated (in-place page writes, like a decode step's row
+    append). The promoted page is bit-identical to the spilled one:
+    same int8 rows, same scales — the quantized analogue of the COW
+    clone guarantee."""
+
+    def insert(cache, page_ids, k_tile, v_tile, k_scale, v_scale):
+        return cache._replace(
+            k=cache.k.at[:, page_ids].set(k_tile),
+            v=cache.v.at[:, page_ids].set(v_tile),
+            k_scale=cache.k_scale.at[:, page_ids].set(k_scale),
+            v_scale=cache.v_scale.at[:, page_ids].set(v_scale))
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
 def make_tile_transfer_fns(mesh=None, rules=None) -> Tuple[Callable,
                                                            Callable]:
     """``(gather_fn, shard_fn)`` for page tiles on a real multi-device

@@ -690,7 +690,8 @@ def _observed_decode_probe():
 
 
 _SCENARIO_SEED = {"chat": 101, "batch_completion": 102,
-                  "long_context": 103, "shared_prefix": 104}
+                  "long_context": 103, "shared_prefix": 104,
+                  "cache_hierarchy": 105}
 
 
 def _scenario_arrivals(name, vocab):
@@ -740,6 +741,18 @@ def _scenario_arrivals(name, vocab):
             out.append((t, Request(
                 prompt=prefix + tok(int(rng.integers(2, 7))),
                 max_new_tokens=4)))
+    elif name == "cache_hierarchy":
+        # zipf-popular 12-token "system prompts" over a pool too small
+        # to keep them all HBM-resident: hot prefixes churn out, spill
+        # to the host tier, and promote back on re-arrival — the
+        # hierarchical KV-cache's home workload
+        bases = [tok(12) for _ in range(4)]
+        for _ in range(10):
+            t += int(rng.poisson(8.0))
+            r = min(int(rng.zipf(2.0)), len(bases)) - 1
+            out.append((t, Request(
+                prompt=bases[r] + tok(int(rng.integers(2, 7))),
+                max_new_tokens=4)))
     else:
         raise ValueError(f"unknown scenario {name!r}")
     return out
@@ -781,12 +794,13 @@ def bench_gpt_serving_scenarios(on_tpu):
 
     from apex_tpu.models.gpt import gpt_tiny, init_gpt
     from apex_tpu.serving import (ContinuousBatchingScheduler,
-                                  PagedDecodeEngine, Tracer)
+                                  PagedDecodeEngine, PrefixRegistry,
+                                  Tracer)
 
     cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
     params = init_gpt(jax.random.PRNGKey(0), cfg)
     names = ("chat", "batch_completion", "long_context",
-             "shared_prefix")
+             "shared_prefix", "cache_hierarchy")
     # APEX_BENCH_SCENARIOS=chat[,mix...] narrows the sweep — the
     # run_tests.sh quick tier smokes a single mix this way
     only = os.environ.get("APEX_BENCH_SCENARIOS")
@@ -797,11 +811,17 @@ def bench_gpt_serving_scenarios(on_tpu):
         try:
             trc = Tracer()
             # fresh engine per mix: the latency histograms live on the
-            # tracer's registry and must not bleed across scenarios
-            eng = PagedDecodeEngine(params, cfg, num_slots=2,
-                                    max_len=64, num_pages=48,
-                                    page_size=4, buckets=(16, 64),
-                                    tracer=trc)
+            # tracer's registry and must not bleed across scenarios.
+            # The cache_hierarchy mix runs over a DELIBERATELY small
+            # pool plus a host tier, so its hot prefixes spill and
+            # promote instead of staying HBM-resident
+            tier = PrefixRegistry(1 << 20) \
+                if name == "cache_hierarchy" else None
+            eng = PagedDecodeEngine(
+                params, cfg, num_slots=2, max_len=64,
+                num_pages=20 if tier is not None else 48,
+                page_size=4, buckets=(16, 64), tracer=trc,
+                host_tier=tier)
             sched = ContinuousBatchingScheduler(eng, eos_id=-1,
                                                 chunk_tokens=8)
             arrivals = _scenario_arrivals(name, cfg.vocab_size)
@@ -813,6 +833,13 @@ def bench_gpt_serving_scenarios(on_tpu):
                      "prefill_chunks": sched.stats.prefill_chunks,
                      "chunk_tokens": 8,
                      "tick_token_budget": sched.tick_token_budget}
+            if tier is not None:
+                extra.update(
+                    host_spills=eng.stats.host_spills,
+                    host_promotes=eng.stats.host_promotes,
+                    host_promote_ticks=eng.stats.host_promote_ticks,
+                    **{k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in tier.stats().items()})
             extra.update(lat)
             _maybe_dump_trace(trc, f"scenario_{name}")
             emit(metric, lat.get("itl_p99", 0.0), "ticks", extra=extra,
@@ -1273,6 +1300,84 @@ def _disagg_vs_colocated_ab_pair(on_tpu):
     streams_b, lat_b, sample_b = side(False)
     assert streams_a == streams_b, "disaggregated streams diverged"
     return sample_a, sample_b
+
+
+def _host_hit_vs_reprefill_ab_pair(on_tpu):
+    """(side_a, side_b): admitting a hot prompt whose pages live in the
+    HOST TIER (a prefix-registry hit: promote + suffix prefill) vs
+    re-prefilling it from scratch, scored as TTFT IN SCHEDULER TICKS.
+    A promotion charges transfer ticks while the forward runs only the
+    uncovered suffix's sequential depth, so the win is pinned at the
+    depth ratio: with a 16-token prompt, 12 covered tokens and 1
+    promote tick, side B must pay >= 16/5 x side A's TTFT — asserted,
+    not just reported. Before timing, committed streams are asserted
+    bit-identical to the spill-disabled scheduler across greedy +
+    sampled, spec off/on, and through the disaggregated router pair
+    sharing one registry — the hierarchy may only move the clock.
+    Ratio < 1 = the host tier beats re-prefill."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  DisaggregatedRouter, FaultInjector,
+                                  PagedDecodeEngine, PrefixRegistry,
+                                  Request, Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    hot = tuple(range(7, 23))               # 16 tokens = 4 pages
+
+    def engine(tier, trc=None, inj=None, spec_k=0):
+        return PagedDecodeEngine(params, cfg, num_slots=2, max_len=32,
+                                 num_pages=12, page_size=4,
+                                 buckets=(16, 32), spec_k=spec_k,
+                                 tracer=trc or Tracer(), injector=inj,
+                                 host_tier=tier)
+
+    def primed_tier():
+        """A registry holding the hot prompt's full chain: prefill it
+        once, release, and drain the pool so every page spills."""
+        tier = PrefixRegistry(1 << 20)
+        eng = engine(tier)
+        eng.prefill(0, hot)
+        eng.free_slot(0)
+        while eng.pool.alloc() is not None:
+            pass
+        assert eng.stats.host_spills == 4, eng.stats.host_spills
+        return tier
+
+    def run(tier, temperature=0.0, spec_k=0, disagg=False):
+        trc = Tracer()
+        if disagg:
+            inj = FaultInjector()
+            sched = DisaggregatedRouter(
+                engine(tier, trc, inj, spec_k),
+                engine(tier, trc, inj, spec_k), eos_id=-1)
+        else:
+            sched = ContinuousBatchingScheduler(
+                engine(tier, trc, spec_k=spec_k), eos_id=-1)
+        sched.submit(Request(prompt=hot, max_new_tokens=4,
+                             temperature=temperature, seed=5))
+        sched.run()
+        out = sched.outcomes[0]
+        return list(out.tokens), float(out.ttft_ticks)
+
+    # bit-identity sweep: the hierarchy must not move a single token
+    for kw in ({}, {"temperature": 1.0}, {"spec_k": 2},
+               {"disagg": True}):
+        streams_a, _ = run(primed_tier(), **kw)
+        streams_b, _ = run(None, **kw)
+        assert streams_a == streams_b, \
+            f"host-tier streams diverged under {kw or 'greedy'}"
+
+    streams_a, ttft_a = run(primed_tier())
+    streams_b, ttft_b = run(None)
+    assert streams_a == streams_b
+    covered, promote_ticks = 12, 1          # skip 3 of 4 pages
+    depth_ratio = len(hot) / (len(hot) - covered + promote_ticks)
+    assert ttft_b >= ttft_a * depth_ratio, \
+        (ttft_a, ttft_b, depth_ratio)
+    return (lambda: ttft_a), (lambda: ttft_b)
 
 
 def _decode_cache_ab_pair(on_tpu):
@@ -1852,6 +1957,9 @@ AB_PAIRS = {
     "serving_disagg_vs_colocated": (
         "disagg_router", "colocated",
         _disagg_vs_colocated_ab_pair),
+    "prefix_host_hit_vs_reprefill": (
+        "host_tier_hit", "reprefill",
+        _host_hit_vs_reprefill_ab_pair),
     "decode_w8_vs_bf16": (
         "w8_weights", "bf16_weights",
         _w8_decode_ab_pair),

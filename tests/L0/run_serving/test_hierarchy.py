@@ -1,0 +1,448 @@
+"""Hierarchical KV-cache: host-memory spill tier + prefix registry.
+
+Covers the two-tier contract end to end:
+
+- LRU-evicted sole-owned prefix pages SPILL to the shared
+  :class:`PrefixRegistry`; registry hits at admission PROMOTE them
+  back, and the promoted bytes are BITWISE equal to what was spilled
+  (float pools and the int8 pool including its scale planes);
+- pages a slot still attends (refcount > 1) never spill;
+- committed streams are bit-identical to a spill-disabled engine —
+  greedy and sampled, speculative on and off, chunked admission, and
+  through the :class:`DisaggregatedRouter` pair sharing one registry;
+- the ``host_spill`` / ``host_promote`` fault sites degrade gracefully
+  (failed promote re-prefills) and multi-fault seeds replay
+  bit-for-bit, with the registry audited every tick (``audit=True``);
+- corrupt/stale registry records are quarantined (dropped, never
+  installed) by the checksum + header verification.
+
+``APEX_CHAOS_SPILL_SEED`` (comma-separated ints) overrides the seeds
+the multi-fault leg sweeps — the CI chaos matrix fans these out.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt import gpt_tiny, init_gpt
+from apex_tpu.serving import (
+    ContinuousBatchingScheduler, DisaggregatedRouter, FaultInjector,
+    PagedDecodeEngine, PoolInvariantError, PrefixRegistry, Request,
+    SpillRecord, Tracer, prefix_page_keys,
+)
+
+pytestmark = pytest.mark.chaos
+
+EOS = -1
+MAX_LEN = 32
+_SPILL_SEEDS = tuple(
+    int(s) for s in os.environ.get("APEX_CHAOS_SPILL_SEED",
+                                   "0,1,2").split(","))
+
+#: The hot prefix every hierarchy run re-admits (2 pages at
+#: page_size 4), plus cold prompts that churn it out of HBM.
+HOT = tuple(range(7, 15))
+COLD = ((101, 102, 103, 104, 105, 106, 107, 108),
+        (201, 202, 203, 204, 205, 206, 207, 208),
+        (301, 302, 303, 304, 305, 306, 307, 308))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                              hidden_dropout=0.0)
+    return cfg, init_gpt(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(model, host_tier=None, injector=None, num_pages=10,
+            **kw):
+    cfg, params = model
+    kw.setdefault("tracer", Tracer())
+    kw.setdefault("cache_dtype", jnp.float32)
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                             num_pages=num_pages, page_size=4,
+                             buckets=(16, 32), injector=injector,
+                             host_tier=host_tier, **kw)
+
+
+def _churn_reqs():
+    """Admit HOT, churn it out through three cold prompts (the 8-page
+    pool spills it), re-admit HOT — once greedy, once sampled."""
+    return ([Request(prompt=HOT, max_new_tokens=4)]
+            + [Request(prompt=p, max_new_tokens=4) for p in COLD]
+            + [Request(prompt=HOT, max_new_tokens=4,
+                       temperature=1.0, seed=3)])
+
+
+def _drive(engine, reqs, **kw):
+    sched = ContinuousBatchingScheduler(engine, eos_id=EOS, audit=True,
+                                        **kw)
+    for r in reqs:
+        sched.submit(r)
+    return sched, sched.run()
+
+
+@pytest.fixture(scope="module")
+def golden_run(model):
+    """ONE fault-free tier-less churn drive, shared by every test that
+    only needs the golden streams (none of them reuse the engine)."""
+    return _drive(_engine(model), _churn_reqs())
+
+
+# -- spill / promote mechanics ----------------------------------------------
+
+def test_spill_on_evict_then_promote_bitwise_equal(model):
+    """Pool churn spills the hot prefix; re-admission promotes it and
+    the promoted HBM pages carry the exact bytes that were spilled."""
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)
+    keys = prefix_page_keys(list(HOT), eng.page_size)
+    pages0 = list(eng._slot_pages[0])
+    snap = [np.asarray(t) for t in eng._tier_extract(
+        eng.cache, jnp.asarray(pages0, jnp.int32))]
+    eng.free_slot(0)
+    # drain the pool: the sweep must spill both registered hot pages
+    held = []
+    while True:
+        p = eng.pool.alloc()
+        if p is None:
+            break
+        held.append(p)
+    assert eng.stats.host_spills == len(keys) == 2
+    assert all(k in tier for k in keys)
+    tier.check_invariants()
+    for p in held:
+        eng.pool.release(p)
+    # promotion: the registry chain refills HBM with identical bytes
+    promoted, ticks = eng._promote_chain(keys, 0)
+    assert len(promoted) == 2 and ticks >= 1
+    assert eng.stats.host_promotes == 2
+    after = [np.asarray(t) for t in eng._tier_extract(
+        eng.cache, jnp.asarray(promoted, jnp.int32))]
+    for a, b in zip(snap, after):
+        np.testing.assert_array_equal(a, b)
+    for p in promoted:
+        eng.pool.release(p)
+    assert tier.hits == 2 and tier.hit_rate > 0
+
+
+def test_int8_promote_roundtrips_pages_and_scales(model):
+    """The int8 pool spills quantized tiles WITH their per-page scale
+    planes; promotion restores both bitwise (a page that came back
+    without its scales would dequantize wrong)."""
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier, cache_dtype=jnp.int8)
+    eng.prefill(0, HOT)
+    keys = prefix_page_keys(list(HOT), eng.page_size)
+    pages0 = list(eng._slot_pages[0])
+    snap = [np.asarray(t) for t in eng._tier_extract(
+        eng.cache, jnp.asarray(pages0, jnp.int32))]
+    assert len(snap) == 4  # k, v, k_scale, v_scale
+    eng.free_slot(0)
+    held = []
+    while (p := eng.pool.alloc()) is not None:
+        held.append(p)
+    rec = tier.get(keys[0])
+    assert rec is not None and rec.k_scale is not None
+    assert rec.k.dtype == np.int8
+    for p in held:
+        eng.pool.release(p)
+    promoted, _ = eng._promote_chain(keys, 0)
+    assert len(promoted) == 2
+    after = [np.asarray(t) for t in eng._tier_extract(
+        eng.cache, jnp.asarray(promoted, jnp.int32))]
+    for a, b in zip(snap, after):
+        np.testing.assert_array_equal(a, b)
+    for p in promoted:
+        eng.pool.release(p)
+
+
+def test_attended_pages_never_spill(model):
+    """A page a slot still attends (refcount > 1) leaves the registry
+    on the sweep WITHOUT spilling: only the registry's sole reference
+    guarantees the rows are the pristine registered prefix."""
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)       # slot 0 holds the pages; registry too
+    keys = prefix_page_keys(list(HOT), eng.page_size)
+    assert all(eng.pool.refcount(p) == 2 for p in eng._slot_pages[0])
+    held = []
+    while (p := eng.pool.alloc()) is not None:
+        held.append(p)
+    assert eng.stats.host_spills == 0 and len(tier) == 0
+    assert all(k not in tier for k in keys)
+    # the slot still serves its prefix from HBM, untouched
+    assert all(eng.pool.refcount(p) == 1 for p in eng._slot_pages[0])
+    for p in held:
+        eng.pool.release(p)
+    eng.free_slot(0)
+    eng.pool.check_invariants()
+
+
+def test_registry_budget_lru_and_oversized_rejection(model):
+    """Byte-budgeted LRU: admission evicts the coldest records to fit;
+    a single record over the whole budget is rejected, not admitted."""
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)
+    eng.free_slot(0)
+    while eng.pool.alloc() is not None:
+        pass
+    rec = next(iter(tier._entries.values()))
+    small = PrefixRegistry(rec.nbytes)          # exactly one record
+    keys = list(tier._entries)
+    assert small.put(keys[0], tier._entries[keys[0]])
+    assert small.put(keys[1], tier._entries[keys[1]])
+    assert len(small) == 1 and small.evictions == 1
+    assert keys[0] not in small and keys[1] in small
+    small.check_invariants()
+    tiny = PrefixRegistry(rec.nbytes - 1)
+    assert not tiny.put(keys[0], tier._entries[keys[0]])
+    assert tiny.rejected == 1 and len(tiny) == 0
+    # dedup: re-putting an existing key only refreshes recency
+    assert not small.put(keys[1], tier._entries[keys[1]])
+
+
+def test_registry_invariants_catch_corruption(model):
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)
+    eng.free_slot(0)
+    while eng.pool.alloc() is not None:
+        pass
+    tier.check_invariants()
+    key = next(iter(tier._entries))
+    rec = tier._entries[key]
+    tier._entries[key] = rec._replace(
+        k=np.ascontiguousarray(rec.k) + 1)      # payload no longer
+    with pytest.raises(PoolInvariantError,                # checksums
+                       match="fails its spill checksum"):
+        tier.check_invariants()
+    tier._entries[key] = rec
+    tier._bytes += 1
+    with pytest.raises(PoolInvariantError, match="drifted"):
+        tier.check_invariants()
+    tier._bytes -= 1
+    with pytest.raises(ValueError, match="different chain key"):
+        tier.put(b"\x00" * 32, rec)
+
+
+def test_corrupt_record_quarantined_promote_degrades(model, golden_run):
+    """A record whose payload rotted in host memory fails checksum
+    verification at promote time: it is DROPPED (never installed) and
+    the admission silently re-prefills — committed stream untouched."""
+    _, golden = golden_run
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)
+    eng.free_slot(0)
+    while eng.pool.alloc() is not None:
+        pass
+    keys = prefix_page_keys(list(HOT), eng.page_size)
+    rec = tier._entries[keys[0]]
+    flipped = np.ascontiguousarray(rec.k).copy()
+    flipped.flat[0] = -flipped.flat[0] if flipped.flat[0] else 1
+    tier._entries[keys[0]] = SpillRecord(
+        rec.header, flipped, rec.v, rec.k_scale, rec.v_scale,
+        rec.digest)
+    promoted, ticks = eng._promote_chain(keys, 0)
+    assert promoted == [] and ticks == 0
+    assert eng.stats.host_promote_failures == 1
+    assert keys[0] not in tier        # quarantined
+    # and a full scheduler run over the same shape stays golden
+    tier2 = PrefixRegistry(1 << 20)
+    eng2 = _engine(model, host_tier=tier2)
+    _, outs = _drive(eng2, _churn_reqs())
+    assert outs == golden
+
+
+def test_stale_header_key_is_rejected(model):
+    """A record registered under one chain key can never install under
+    another — the transfer tier's wrong-prompt guarantee, extended."""
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    eng.prefill(0, HOT)
+    eng.free_slot(0)
+    while eng.pool.alloc() is not None:
+        pass
+    keys = prefix_page_keys(list(HOT), eng.page_size)
+    other = prefix_page_keys([9, 9, 9, 9], eng.page_size)
+    rec = tier._entries[keys[0]]
+    # graft the foreign record under 'other' bypassing put()'s check
+    tier._entries[other[0]] = rec
+    tier._bytes += rec.nbytes
+    promoted, _ = eng._promote_chain(other, 0)
+    assert promoted == []
+    assert eng.stats.host_promote_failures == 1
+    assert other[0] not in tier
+
+
+# -- stream bit-identity -----------------------------------------------------
+
+@pytest.mark.parametrize("variant", ["plain", "spec", "chunked"])
+def test_streams_bit_identical_to_spill_disabled(model, golden_run,
+                                                 variant):
+    """The hierarchy is invisible to committed streams: greedy AND
+    sampled tokens match a spill-disabled engine bit for bit, with
+    spec decode on, and under chunked admission — while the spill and
+    promote paths demonstrably ran."""
+    eng_kw = {"spec_k": 2} if variant == "spec" else {}
+    sched_kw = {"chunk_tokens": 8} if variant == "chunked" else {}
+    if variant == "plain":
+        _, golden = golden_run
+    else:
+        _, golden = _drive(_engine(model, **eng_kw), _churn_reqs(),
+                           **sched_kw)
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier, **eng_kw)
+    _, outs = _drive(eng, _churn_reqs(), **sched_kw)
+    assert outs == golden
+    assert eng.stats.host_spills > 0
+    assert eng.stats.host_promotes > 0
+    assert eng.stats.host_promote_ticks >= 1
+    assert tier.hit_rate > 0
+
+
+def test_int8_streams_bit_identical(model):
+    """The int8 pool keeps its monolithic prefill (the chunk core
+    refuses quantized pools) — promotion is purely a capacity win and
+    the streams must not move."""
+    _, golden = _drive(_engine(model, cache_dtype=jnp.int8),
+                       _churn_reqs())
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier, cache_dtype=jnp.int8)
+    _, outs = _drive(eng, _churn_reqs())
+    assert outs == golden
+    assert eng.stats.host_promotes > 0
+
+
+def test_promote_reprices_the_admission_clock(model, golden_run):
+    """A host-tier hit admits at the SUFFIX depth plus promote ticks —
+    the re-admitted hot prompt's TTFT beats the spill-disabled
+    engine's re-prefill on the tick clock."""
+    sched_b, golden = golden_run
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier)
+    sched_a, outs = _drive(eng, _churn_reqs())
+    assert outs == golden
+    rid = len(_churn_reqs()) - 1              # the re-admitted HOT
+    ttft_a = sched_a.outcomes[rid].ttft_ticks
+    ttft_b = sched_b.outcomes[rid].ttft_ticks
+    assert ttft_a < ttft_b, (ttft_a, ttft_b)
+
+
+# -- fault sites -------------------------------------------------------------
+
+def test_host_spill_fault_drops_the_spill_gracefully(model, golden_run):
+    """A fired ``host_spill`` drops that page from both tiers; streams
+    stay golden (the prefix just re-prefills later)."""
+    _, golden = golden_run
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier,
+                  injector=FaultInjector(schedule={"host_spill": (0,)}))
+    _, outs = _drive(eng, _churn_reqs())
+    assert outs == golden
+    assert eng.stats.host_spill_failures == 1
+
+
+def test_host_promote_fault_degrades_to_reprefill(model, golden_run):
+    """A fired ``host_promote`` breaks the chain mid-promotion; the
+    remainder re-prefills and the committed stream stays golden."""
+    _, golden = golden_run
+    tier = PrefixRegistry(1 << 20)
+    eng = _engine(model, host_tier=tier,
+                  injector=FaultInjector(
+                      schedule={"host_promote": (0,)}))
+    _, outs = _drive(eng, _churn_reqs())
+    assert outs == golden
+    assert eng.stats.host_promote_failures == 1
+
+
+@pytest.mark.parametrize("seed", _SPILL_SEEDS)
+def test_multi_fault_seeds_stay_golden_and_replay(model, golden_run,
+                                                  seed):
+    """Rate-driven spill AND promote faults together: every run stays
+    bit-identical to golden (these sites never corrupt streams), and
+    the same seed replays the same fault pattern and stats."""
+    _, golden = golden_run
+
+    def run():
+        tier = PrefixRegistry(1 << 20)
+        eng = _engine(model, host_tier=tier,
+                      injector=FaultInjector(
+                          seed=seed, rates={"host_spill": 0.5,
+                                            "host_promote": 0.5}))
+        _, outs = _drive(eng, _churn_reqs())
+        return eng, tier, outs
+
+    eng_a, tier_a, outs_a = run()
+    eng_b, tier_b, outs_b = run()
+    assert outs_a == golden and outs_b == golden
+    assert outs_a == outs_b
+    for f in ("host_spills", "host_spill_failures", "host_promotes",
+              "host_promote_failures", "host_promote_ticks"):
+        assert getattr(eng_a.stats, f) == getattr(eng_b.stats, f), f
+    assert tier_a.stats() == tier_b.stats()
+    assert eng_a.injector.counts == eng_b.injector.counts
+    # CI post-mortem artifact: one Perfetto dump per sweep seed,
+    # uploaded by the chaos workflow legs
+    out_path = os.environ.get("APEX_CHAOS_TRACE_OUT")
+    if out_path:
+        root, ext = os.path.splitext(out_path)
+        eng_a.tracer.dump_jsonl(
+            f"{root}.spill_seed{seed}{ext or '.jsonl'}")
+
+
+# -- the disaggregated pair --------------------------------------------------
+
+def _disagg(model, tier, reqs):
+    cfg, params = model
+    inj, trc = FaultInjector(), Tracer()
+    kw = dict(num_slots=2, max_len=MAX_LEN, num_pages=10, page_size=4,
+              buckets=(16, 32), cache_dtype=jnp.float32, injector=inj,
+              tracer=trc, host_tier=tier)
+    pe = PagedDecodeEngine(params, cfg, **kw)
+    de = PagedDecodeEngine(params, cfg, **kw)
+    router = DisaggregatedRouter(pe, de, eos_id=EOS, audit=True)
+    for r in reqs:
+        router.submit(r)
+    return pe, de, router, router.run()
+
+
+def test_disagg_pair_shares_one_registry(model):
+    """Both replicas spill into and promote from the SAME registry —
+    one replica's prefill seeds everyone's cache — and the routed
+    streams stay bit-identical to the tier-less pair."""
+    _, _, _, golden = _disagg(model, None, _churn_reqs())
+    tier = PrefixRegistry(1 << 20)
+    pe, de, router, outs = _disagg(model, tier, _churn_reqs())
+    assert outs == golden
+    assert de.stats.host_promotes > 0       # active-side promotion
+    assert pe.stats.host_spills + de.stats.host_spills > 0
+    assert tier.hit_rate > 0
+
+
+def test_disagg_rejects_mismatched_tiers(model):
+    cfg, params = model
+    inj, trc = FaultInjector(), Tracer()
+    kw = dict(num_slots=2, max_len=MAX_LEN, num_pages=10, page_size=4,
+              buckets=(16, 32), injector=inj, tracer=trc)
+    pe = PagedDecodeEngine(params, cfg, host_tier=PrefixRegistry(1024),
+                           **kw)
+    de = PagedDecodeEngine(params, cfg, host_tier=None, **kw)
+    with pytest.raises(ValueError, match="share ONE PrefixRegistry"):
+        DisaggregatedRouter(pe, de, eos_id=EOS)
+
+
+def test_int8_engine_requires_known_dtype_tag(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="no spill wire tag"):
+        PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                          num_pages=10, page_size=4, buckets=(16, 32),
+                          cache_dtype=jnp.int32,
+                          host_tier=PrefixRegistry(1024))

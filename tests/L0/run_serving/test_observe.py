@@ -226,6 +226,65 @@ def test_pool_gauges_track_the_pool(model):
     assert reg.get("serving_page_pool_occupancy").value \
         == pytest.approx(eng.pool.occupancy)
     assert 0.0 <= eng.pool.occupancy <= 1.0
+    # dense/tier-less engines never create the host-tier gauges
+    assert reg.get("serving_page_pool_host_pages") is None
+
+
+def _drive_hierarchy(model, tracer):
+    """Churn a hot prefix through a small pool so the host tier's
+    spill AND promote paths both run under tracing."""
+    from apex_tpu.serving import PrefixRegistry
+    cfg, params = model
+    tier = PrefixRegistry(1 << 20)
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                            num_pages=10, page_size=4, buckets=(16, 32),
+                            tracer=tracer, host_tier=tier)
+    sched = ContinuousBatchingScheduler(eng, eos_id=EOS, audit=True)
+    hot = tuple(range(7, 15))
+    for p in (hot, (101, 102, 103, 104, 105, 106, 107, 108),
+              (201, 202, 203, 204, 205, 206, 207, 208),
+              (301, 302, 303, 304, 305, 306, 307, 308), hot):
+        sched.submit(Request(prompt=p, max_new_tokens=4))
+    sched.run()
+    return eng, tier, sched
+
+
+def test_host_tier_gauges_track_both_tiers(model):
+    """Host-tier engines grow the pool gauge family with per-tier
+    breakdowns, and the values mirror ``PagePool.stats()`` exactly."""
+    trc = Tracer()
+    eng, tier, _ = _drive_hierarchy(model, trc)
+    assert eng.stats.host_spills > 0 and eng.stats.host_promotes > 0
+    reg, stats = trc.registry, eng.pool.stats()
+    assert reg.get("serving_page_pool_hbm_used").value \
+        == stats["hbm_used"]
+    assert reg.get("serving_page_pool_host_pages").value \
+        == stats["host_pages"] == tier.num_pages
+    assert reg.get("serving_page_pool_host_bytes").value \
+        == stats["host_bytes"] == tier.nbytes
+    assert reg.get("serving_page_pool_host_hit_rate").value \
+        == pytest.approx(stats["host_hit_rate"])
+    assert stats["host_hit_rate"] > 0
+    # the spill/promote lifecycle instants carry byte+tick payloads
+    spills = [e for e in trc.events if e.name == "host_spill"]
+    promotes = [e for e in trc.events if e.name == "host_promote"]
+    assert spills and promotes
+    assert all(dict(e.args).get("bytes", 0) > 0 for e in spills)
+    assert any(dict(e.args).get("ticks", 0) >= 1 for e in promotes)
+
+
+def test_host_tier_tick_stream_is_replay_exact(model):
+    """The replay contract holds with the hierarchy live: two runs of
+    the same pinned schedule produce byte-identical tick-clock event
+    streams, spill/promote instants included."""
+    a = Tracer()
+    b = Tracer()
+    _drive_hierarchy(model, a)
+    _drive_hierarchy(model, b)
+    assert a.tick_stream() == b.tick_stream()
+    names = {e.name for e in a.events}
+    assert {"host_spill", "host_promote"} <= names
+    assert names <= set(PHASES) | set(LIFECYCLE)
 
 
 @pytest_chaos

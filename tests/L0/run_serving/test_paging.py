@@ -79,6 +79,43 @@ def test_prefix_page_key_encoding_is_pinned():
         _encode_page((2**31,))
 
 
+def test_spill_header_encoding_is_pinned():
+    """The host-tier spill payload header — ``<IIIIII`` little-endian
+    (version, layers, heads, page_size, head_dim, dtype_tag) followed
+    by the 32-byte chain key — pinned by exact hex. Spill records
+    outlive engines (the registry is shared across replicas), so any
+    drift silently quarantines every resident record at its next
+    promotion: a layout change must bump ``PAGE_KEY_VERSION``."""
+    from apex_tpu.serving.paging import (
+        SPILL_DTYPE_TAGS, SPILL_HEADER_BYTES, decode_spill_header,
+        encode_spill_header, spill_checksum,
+    )
+
+    assert SPILL_HEADER_BYTES == 56
+    assert SPILL_DTYPE_TAGS == {"bfloat16": 1, "float32": 2,
+                                "float16": 3, "int8": 4}
+    key = bytes(range(32))
+    header = encode_spill_header(key, 2, 2, 4, 8, 1)
+    assert header.hex() == (
+        "010000000200000002000000040000000800000001000000"
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f")
+    assert decode_spill_header(header) == {
+        "version": 1, "num_layers": 2, "num_heads": 2, "page_size": 4,
+        "head_dim": 8, "dtype_tag": 1, "key": key}
+    with pytest.raises(ValueError, match="32-byte"):
+        encode_spill_header(b"short", 2, 2, 4, 8, 1)
+    with pytest.raises(ValueError, match="56 bytes"):
+        decode_spill_header(header[:-1])
+    # the checksum binds header AND payload (scale planes included)
+    k = np.arange(8, dtype=np.float32).reshape(1, 1, 1, 2, 4)
+    v = k + 8
+    d = spill_checksum(header, k, v)
+    assert d == spill_checksum(header, k.copy(), v.copy())
+    assert d != spill_checksum(header, k + 1, v)
+    assert d != spill_checksum(header, k, v, k[..., 0, 0], v[..., 0, 0])
+
+
 # -- PagePool ---------------------------------------------------------------
 
 def test_pool_alloc_free_refcount():
