@@ -759,6 +759,43 @@ def _page_handoff_medium_entry():
     return build
 
 
+def _page_reshard_medium_entry():
+    """r17 cost anchor: the sender half of a DEVICE-TO-DEVICE page
+    reshard — ``serving.transfer.make_reshard_extract_fn`` gathering
+    one full prompt's tiles (8 pages x 64 tokens = a 512-token prompt)
+    out of the ragged medium pool (32 slots, s_max 512, page 64, bf16)
+    with the head axis sharded tp=2 over ``model``. The explicit tiled
+    ``all_gather`` is the whole point of the entry: APX511's per-rank
+    simulator verifies both ranks run the identical collective, and
+    budgets.json pins the per-prompt collective volume ((tp-1)/tp of
+    the tile bytes per rank on the ICI/DCN wire) that the pool
+    router's per-link clock prices at ``ici_ticks_per_page`` /
+    ``dcn_ticks_per_page`` — the spec-to-spec alternative to the host
+    bounce's full gather + re-placement budgeted by
+    ``gpt_page_handoff_medium``."""
+    def build():
+        import functools as ft
+
+        import jax
+
+        from apex_tpu.models.gpt import GPTConfig
+        from apex_tpu.serving.cache import RESERVED_PAGES, init_paged_cache
+        from apex_tpu.serving.transfer import make_reshard_extract_fn
+
+        cfg = GPTConfig(use_rope=True)
+        slots, s_max, page = 32, 512, 64
+        lengths = [32 + round(i * (s_max - 32) / (slots - 1))
+                   for i in range(slots)]
+        num_pages = RESERVED_PAGES + sum(-(-l // page) for l in lengths)
+        cache = jax.eval_shape(ft.partial(
+            init_paged_cache, cfg, slots, s_max, num_pages, page))
+        n = s_max // page  # one max-length prompt's page tile
+        fn = make_reshard_extract_fn()
+        return fn, (cache, _sds((n,), "int32"))
+
+    return build
+
+
 def _page_spill_extract_medium_entry():
     """r16 cost anchor: the sender half of a host-tier spill —
     ``serving.transfer.make_extract_pages_fn`` gathering one full
@@ -1465,6 +1502,16 @@ def repo_entries() -> List[TraceEntry]:
         TraceEntry("gpt_page_handoff_medium",
                    "apex_tpu.serving.transfer",
                    _page_handoff_medium_entry(), checks=()),
+        # r17: the reshard tier's sender collective at the same ragged
+        # medium shape — the explicit tiled all_gather over the tp=2
+        # model axis that APX511's per-rank simulator verifies and
+        # budgets.json prices as the per-prompt ICI/DCN collective
+        # volume behind ici_ticks_per_page / dcn_ticks_per_page
+        TraceEntry("gpt_page_reshard_medium",
+                   "apex_tpu.serving.transfer",
+                   _page_reshard_medium_entry(),
+                   checks=("schedule",),
+                   mesh=_mesh(tp=2), min_devices=2),
         # r16: the KV-cache hierarchy's two data movers at the same
         # ragged medium shape — the spill-side page gather (bf16) and
         # the promote-side quantized scatter (int8 + scale planes);

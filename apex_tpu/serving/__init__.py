@@ -59,14 +59,21 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   tiles gathered from a prefill replica's pool and scattered into a
   decode replica's, content-addressed by the chained prefix keys,
   checksum-verified (corrupt payloads quarantined, never attended),
-  retried under a per-handoff budget with every outcome typed;
+  retried under a per-handoff budget with every outcome typed — in
+  two tiers sharing that contract: the host-staged ``PageTransfer``
+  and the device-to-device spec-to-spec ``PageReshard`` (typed
+  ``ReshardFailed`` on exhaustion, degrading back to host staging);
 - ``router``    — the disaggregated serving tier: a
   ``DisaggregatedRouter`` (a ``ContinuousBatchingScheduler`` over a
   two-replica composite engine) admitting prompts on a prefill
   replica, shipping their pages across, decoding on a decode replica
   — with per-replica ``ReplicaHealth`` ladders driven by probe faults,
   graceful colocated fallback, and mid-stream failover whose committed
-  streams stay bit-identical to colocated serving.
+  streams stay bit-identical to colocated serving; and its pool-scale
+  generalization ``PoolRouter``: N prefill x M decode replicas behind
+  one admission queue, load-based prefill routing, headroom-chosen
+  decode placement with N-way failover, per-link-priced reshard
+  handoffs, and the same bit-identical stream contract.
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
@@ -93,8 +100,8 @@ from apex_tpu.serving.health import (  # noqa: F401
     FINISH_REASONS, HEALTH_STATES, AdmissionRejected, DeadlineExceeded,
     LivelockError, NonFiniteLogits, PoolExhausted, PoolInvariantError,
     PromoteFailed, ReplicaHealth, ReplicaUnavailable, RequestOutcome,
-    RetryBudgetExhausted, ServingError, ServingStats, SpillFailed,
-    TransferCorrupt, TransferFailed,
+    ReshardFailed, RetryBudgetExhausted, ServingError, ServingStats,
+    SpillFailed, TransferCorrupt, TransferFailed,
 )
 from apex_tpu.serving.observe import (  # noqa: F401
     FlightRecorder, MetricsRegistry, TraceEvent, Tracer,
@@ -104,7 +111,9 @@ from apex_tpu.serving.paging import (  # noqa: F401
     SpillRecord, decode_spill_header, encode_spill_header,
     prefix_page_keys, spill_checksum,
 )
-from apex_tpu.serving.router import DisaggregatedRouter  # noqa: F401
+from apex_tpu.serving.router import (  # noqa: F401
+    DisaggregatedRouter, PoolRouter,
+)
 from apex_tpu.serving.sampling import (  # noqa: F401
     finite_rows, sample_token_grid, sample_tokens, speculative_accept,
     tree_speculative_accept,
@@ -113,7 +122,8 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
 )
 from apex_tpu.serving.transfer import (  # noqa: F401
-    PageTransfer, make_extract_pages_fn, make_extract_pages_quant_fn,
-    make_insert_pages_fn, make_insert_pages_quant_fn,
+    PageReshard, PageTransfer, make_extract_pages_fn,
+    make_extract_pages_quant_fn, make_insert_pages_fn,
+    make_insert_pages_quant_fn, make_reshard_extract_fn,
     make_tile_transfer_fns, transfer_checksum,
 )

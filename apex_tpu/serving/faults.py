@@ -79,6 +79,26 @@ executables stay fault-free):
                    far are kept, the remainder of the prompt is
                    re-prefilled — the recovered stream is bit-identical
                    to golden
+``reshard_send``   one device-to-device reshard attempt fails before
+                   any bytes move (``serving.transfer.PageReshard``) —
+                   a dropped spec-to-spec send over the ICI/DCN link.
+                   Retried under the reshard's own budget; exhaustion
+                   raises :class:`~apex_tpu.serving.health.ReshardFailed`
+                   and the pool router degrades the handoff to the
+                   HOST-STAGED ``PageTransfer`` path (which draws
+                   ``page_send``/``page_recv`` as usual)
+``reshard_recv``   the resharded page payload is corrupted in flight
+                   (one staged byte flipped, payload-selected) — the
+                   chain-key-bound checksum catches it, the tiles are
+                   QUARANTINED, and the attempt counts against the
+                   reshard budget exactly like ``reshard_send``
+``pool_route``     one load-based routing decision is degraded
+                   (``serving.router.PoolRouter`` draws once per
+                   remote-prefill admission): the load snapshot is
+                   treated as unavailable and the router falls back to
+                   the FIRST routable prefill replica in fixed pool
+                   order — a routing-policy fault, never a stream
+                   fault (placement cannot move committed tokens)
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from
@@ -92,7 +112,8 @@ from typing import Dict, Iterable, Mapping, Optional, Tuple
 #: The named fault sites, in the order the docs list them.
 SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
          "decode_exec", "sample", "draft_exec", "page_send", "page_recv",
-         "replica_health", "host_spill", "host_promote")
+         "replica_health", "host_spill", "host_promote", "reshard_send",
+         "reshard_recv", "pool_route")
 
 
 class InjectedFault(RuntimeError):

@@ -54,6 +54,13 @@ Taxonomy (all subclass :class:`ServingError`):
                             state is ``down``, or its own page pool
                             refused the prompt — the router serves the
                             request colocated on the surviving engine
+:class:`ReshardFailed`      a device-to-device page reshard exhausted
+                            its retry budget (``reshard_send`` drops or
+                            ``reshard_recv`` corruption); the pool
+                            router degrades the handoff to the
+                            host-staged ``PageTransfer`` path — a
+                            subclass of :class:`TransferFailed`, so
+                            single-pair callers keep their ladder
 :class:`SpillFailed`        an HBM→host page spill was dropped (the
                             ``host_spill`` fault site, or a payload the
                             host tier rejected); the evicted prefix
@@ -191,6 +198,24 @@ class TransferCorrupt(ServingError):
         self.attempts = attempts
         self.pages = pages
         self.payload.update(attempts=attempts, pages=pages)
+
+
+class ReshardFailed(TransferFailed):
+    """A device-to-device page reshard (``serving.transfer.PageReshard``,
+    the spec-to-spec ICI/DCN tier) exhausted its per-handoff retry
+    budget — every attempt dropped at ``reshard_send`` or quarantined at
+    the ``reshard_recv`` checksum (``corrupt`` tells which ended the
+    run). The pool router catches it and re-ships the SAME pages over
+    the host-staged ``PageTransfer`` channel: the reshard tier may only
+    lose performance, never a request. Subclasses
+    :class:`TransferFailed` so any caller handling the single-pair
+    taxonomy keeps its ladder unchanged."""
+
+    def __init__(self, msg: str, *, attempts: int = 0, pages: int = 0,
+                 corrupt: bool = False):
+        super().__init__(msg, attempts=attempts, pages=pages)
+        self.corrupt = corrupt
+        self.payload.update(corrupt=corrupt)
 
 
 class ReplicaUnavailable(ServingError):
@@ -344,6 +369,12 @@ STAT_FIELDS = {
     "transfer_retries": "page-handoff attempts retried",
     "transfer_corrupt": "handoff payloads quarantined on checksum",
     "transfer_failures": "handoffs abandoned (budget exhausted)",
+    "reshards": "device-to-device page reshards delivered and verified",
+    "reshard_retries": "reshard attempts retried over the ICI/DCN link",
+    "reshard_corrupt": "reshard payloads quarantined on checksum",
+    "reshard_failures": "reshards abandoned (degraded to host staging)",
+    "route_fallbacks": "pool_route faults: fixed-order routing used",
+    "rebalances": "decode placement moved to a sibling replica",
     "failovers": "active-replica switches (slots drained + requeued)",
     "host_spills": "pages spilled HBM->host on LRU eviction",
     "host_spill_failures": "spills dropped (fault or tier rejection)",

@@ -48,19 +48,24 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 #: Per-tick phase spans, in tick order. ``exec`` covers the jitted
 #: decode / verify / tree-verify dispatch inside the engine;
 #: ``chunk_prefill`` one jitted prompt-chunk forward (several may run
-#: per tick, one span each); ``page_transfer`` one cross-replica page
-#: handoff (``serving.transfer.PageTransfer``, retries included in the
-#: span); the rest are host-side scheduler phases.
+#: per tick, one span each); ``page_transfer`` one host-staged
+#: cross-replica page handoff (``serving.transfer.PageTransfer``,
+#: retries included in the span); ``reshard`` one device-to-device
+#: spec-to-spec page reshard (``serving.transfer.PageReshard`` — the
+#: pool router's default handoff); the rest are host-side scheduler
+#: phases.
 PHASES = ("draft", "prepare_decode", "exec", "accept", "commit",
-          "chunk_prefill", "page_transfer")
+          "chunk_prefill", "page_transfer", "reshard")
 
 #: Per-request lifecycle instants. ``host_spill`` / ``host_promote``
 #: mark KV pages crossing the HBM <-> host-tier boundary (one instant
 #: per spilled page / per promoted chain, ``ok=False`` on a fault or
-#: verification failure).
+#: verification failure); ``rebalance`` marks the pool router moving
+#: decode placement onto a sibling replica (the N-way failover pick,
+#: chosen by pages-free headroom).
 LIFECYCLE = ("submitted", "admitted", "prefill", "first_token",
              "preempted", "retried", "quarantined", "failover",
-             "finished", "host_spill", "host_promote")
+             "finished", "host_spill", "host_promote", "rebalance")
 
 #: Default histogram buckets for tick-denominated latencies (TTFT,
 #: inter-token). Roughly geometric: fine where SLOs live, coarse in

@@ -563,8 +563,10 @@ class DecodeEngine:
         charges the ``default`` (the forward's sequential depth);
         engines that replaced part of that depth with cheaper work
         stage a different charge here: a host-tier promotion prices
-        the skipped prefix at transfer ticks, and the disaggregated
-        composite prices a remote prefill at handoff ticks. Purely
+        the skipped prefix at transfer ticks, the disaggregated
+        composite prices a remote prefill at handoff ticks, and the
+        pool composite at the per-link reshard horizon it extends
+        (so concurrent handoffs on different links overlap). Purely
         accounting — sampling keys never see the clock."""
         return default
 

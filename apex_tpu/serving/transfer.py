@@ -45,6 +45,29 @@ exactly that pair from the pool's TP layout (heads over ``model``);
 the single-device default degenerates to a host round-trip, which is
 also what keeps CPU chaos tests byte-faithful.
 
+Two channel tiers share that contract (same chain keys, same checksum,
+same quarantine, same retry discipline — only the link and the fault
+sites differ):
+
+- :class:`PageTransfer` — the HOST-STAGED bounce (gather to host,
+  checksum, place on the destination), priced by the router at
+  ``handoff_ticks_per_page``. Fault sites ``page_send``/``page_recv``.
+- :class:`PageReshard` — the DEVICE-TO-DEVICE spec-to-spec reshard
+  (the alpa-style ShardingSpec-to-ShardingSpec transfer of SNIPPETS.md
+  [3]): page tiles move between the source and destination engines'
+  sub-meshes without the host bounce, priced per link
+  (``ici_ticks_per_page`` within a slice, ``dcn_ticks_per_page``
+  across slices — both cheaper than the host staging they replace).
+  Fault sites ``reshard_send``/``reshard_recv``; budget exhaustion
+  raises the typed :class:`~apex_tpu.serving.health.ReshardFailed`
+  and the pool router re-ships the same pages host-staged — the
+  reshard tier may lose performance, never a request.
+  :func:`make_reshard_extract_fn` is its traced sender half: a
+  ``shard_map`` whose explicit ``all_gather`` materializes the wire
+  tile from the TP-sharded pool, so the APX511 per-rank simulator and
+  the APX6xx cost interpreter see (and budget) the collective volume
+  the reshard moves (``gpt_page_reshard_medium``).
+
 The :class:`PageTransfer` object itself is host state (attempt
 counters, metric handles) — APX401 registers this module accordingly;
 the jitted extract/insert closures touch none of it.
@@ -58,8 +81,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.serving.faults import FaultInjector
-from apex_tpu.serving.health import (ServingStats, TransferCorrupt,
-                                     TransferFailed)
+from apex_tpu.serving.health import (ReshardFailed, ServingStats,
+                                     TransferCorrupt, TransferFailed)
 from apex_tpu.serving.observe import Tracer
 
 #: ``serving_transfer_ticks`` histogram buckets: handoffs are charged
@@ -163,6 +186,40 @@ def make_tile_transfer_fns(mesh=None, rules=None) -> Tuple[Callable,
     return gather_fn, shard_fn
 
 
+def make_reshard_extract_fn(mesh=None) -> Callable:
+    """The traced sender half of a device-to-device reshard:
+    ``jit(shard_map((cache, page_ids) -> (k_tile, v_tile)))`` over the
+    source sub-mesh, where the pool's head axis shards over ``model``
+    and an explicit ``all_gather`` (tiled, rank order — the same order
+    the pool lays heads out in) materializes the full replicated wire
+    tile from the local head shards. Functionally this equals
+    :func:`make_extract_pages_fn` on the gathered cache — the reshard
+    stays bitwise-faithful — but tracing the collective explicitly is
+    the point: the APX511 per-rank simulator verifies every rank runs
+    the same gather, and the cost tier's ``gpt_page_reshard_medium``
+    budgets the collective volume the reshard puts on the ICI/DCN wire
+    (per rank: (tp-1)/tp of the tile bytes, vs the host bounce's full
+    gather + re-placement)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.serving.cache import paged_cache_partition_specs
+    from apex_tpu.transformer import parallel_state as ps
+
+    cspecs = paged_cache_partition_specs()
+
+    def extract(cache, page_ids):
+        k = jax.lax.all_gather(cache.k[:, page_ids], "model", axis=2,
+                               tiled=True)
+        v = jax.lax.all_gather(cache.v[:, page_ids], "model", axis=2,
+                               tiled=True)
+        return k, v
+
+    sharded = ps.shard_map(extract, mesh=mesh,
+                           in_specs=(cspecs, P()),
+                           out_specs=(P(), P()))
+    return jax.jit(sharded)
+
+
 def _default_gather(k_tile, v_tile):
     return np.asarray(k_tile), np.asarray(v_tile)
 
@@ -193,7 +250,28 @@ class PageTransfer:
     ``max_retries`` bounds RE-attempts per handoff (total attempts =
     ``max_retries + 1``). ``gather_fn``/``shard_fn`` override the host
     staging hop for real two-mesh topologies
-    (:func:`make_tile_transfer_fns`)."""
+    (:func:`make_tile_transfer_fns`).
+
+    The class attributes below are the channel's identity — the fault
+    sites it draws, the tracer span it opens, the stat/metric families
+    it bumps, and the typed errors budget exhaustion raises.
+    :class:`PageReshard` overrides exactly these to become the
+    device-to-device tier; the ``ship`` loop (extract → checksum →
+    quarantine → retry) is shared verbatim, which is what keeps the
+    two tiers' robustness contracts identical."""
+
+    #: fault sites drawn per attempt (drop before bytes move / corrupt
+    #: the staged payload in flight)
+    send_site = "page_send"
+    recv_site = "page_recv"
+    #: tracer span name, one per handoff (retries inside the span)
+    span = "page_transfer"
+    #: ``ServingStats`` field family: <prefix>_retries / _corrupt /
+    #: _failures, plus ``delivered_stat`` for verified deliveries
+    stat_prefix = "transfer"
+    delivered_stat = "transfers"
+    #: per-replica labeled metric family in the registry
+    metric_prefix = "serving_transfer"
 
     def __init__(self, injector: Optional[FaultInjector] = None,
                  tracer: Optional[Tracer] = None,
@@ -221,26 +299,34 @@ class PageTransfer:
         c = self._hot.get(replica)
         if c is None:
             r = self.tracer.registry
+            p = self.metric_prefix
             labels = {"replica": replica}
             c = self._hot[replica] = (
-                r.counter("serving_transfer_src_bytes_total",
+                r.counter(f"{p}_src_bytes_total",
                           help="page-handoff bytes shipped from this "
                                "replica (verified payloads only)",
                           labels=labels),
-                r.counter("serving_transfer_src_retries_total",
+                r.counter(f"{p}_src_retries_total",
                           help="handoff attempts retried against this "
                                "replica", labels=labels),
-                r.counter("serving_transfer_src_failures_total",
+                r.counter(f"{p}_src_failures_total",
                           help="handoffs abandoned against this "
                                "replica (budget exhausted)",
                           labels=labels),
-                r.histogram("serving_transfer_ticks",
+                r.histogram(f"{p}_ticks",
                             buckets=TRANSFER_TICK_BUCKETS,
                             help="deterministic tick cost charged per "
                                  "delivered handoff",
                             labels=labels),
             )
         return c
+
+    def _bump(self, field: str, n: int = 1) -> None:
+        """Increment one of the channel's ``ServingStats`` fields
+        (``<stat_prefix>_retries`` etc. — the view resolves to the
+        shared registry counter)."""
+        name = f"{self.stat_prefix}_{field}"
+        setattr(self.stats, name, getattr(self.stats, name) + n)
 
     def observe_ticks(self, replica: str, ticks: int) -> None:
         """Record the tick cost the router charged for a delivered
@@ -276,13 +362,13 @@ class PageTransfer:
             [int(t) for t in tokens], src_engine.page_size)[-1]
         n_pages = len(src_pages)
         if trc.enabled:
-            trc.begin("page_transfer")
+            trc.begin(self.span)
         corrupt_last = False
         for attempt in range(self.max_retries + 1):
             if attempt:
-                self.stats.transfer_retries += 1
+                self._bump("retries")
                 c_retries.inc()
-            if inj.fire("page_send"):
+            if inj.fire(self.send_site):
                 # the send was dropped before any bytes moved
                 if health is not None:
                     health.probe(False)
@@ -291,7 +377,7 @@ class PageTransfer:
                 k_tile, v_tile = self.gather_fn(*self._extract(
                     src_engine.cache, jnp.asarray(src_pages, jnp.int32)))
                 digest = transfer_checksum(k_tile, v_tile, chain_key)
-                fired, payload = inj.draw("page_recv")
+                fired, payload = inj.draw(self.recv_site)
                 if fired:
                     # in-flight corruption: flip one staged byte, the
                     # payload picks which — deterministic per (seed,
@@ -303,7 +389,7 @@ class PageTransfer:
                                      chain_key) != digest:
                     # quarantine: the tiles never reach the receiving
                     # cache; retry re-extracts from the source of truth
-                    self.stats.transfer_corrupt += 1
+                    self._bump("corrupt")
                     corrupt_last = True
                     if health is not None:
                         health.probe(False)
@@ -311,27 +397,81 @@ class PageTransfer:
                 corrupt_last = False
             else:
                 k_tile = v_tile = None
-                inj.draw("page_recv")  # handshake keeps draw order
-            self.stats.transfers += 1
+                inj.draw(self.recv_site)  # handshake keeps draw order
+            setattr(self.stats, self.delivered_stat,
+                    getattr(self.stats, self.delivered_stat) + 1)
             if n_pages:
                 c_bytes.inc(int(k_tile.nbytes) + int(v_tile.nbytes))
             if health is not None:
                 health.probe(True)
             if trc.enabled:
-                trc.end("page_transfer", pages=n_pages,
+                trc.end(self.span, pages=n_pages,
                         attempts=attempt + 1, replica=replica)
             return k_tile, v_tile, attempt + 1
-        self.stats.transfer_failures += 1
+        self._bump("failures")
         c_failures.inc()
         if trc.enabled:
-            trc.end("page_transfer", pages=n_pages,
+            trc.end(self.span, pages=n_pages,
                     attempts=self.max_retries + 1, replica=replica,
                     failed=True)
-        attempts = self.max_retries + 1
+        err = self._budget_error(replica, self.max_retries + 1, n_pages,
+                                 corrupt_last)
+        raise self.tracer.attach(err) if trc.enabled else err
+
+    def _budget_error(self, replica: str, attempts: int, n_pages: int,
+                      corrupt_last: bool):
+        """The typed error a lost budget raises — the one seam the
+        reshard tier's taxonomy differs on."""
         cls = TransferCorrupt if corrupt_last else TransferFailed
-        err = cls(
+        return cls(
             f"page handoff from replica {replica!r} lost all "
             f"{attempts} attempts ({n_pages} pages"
             f"{'; last payload corrupt' if corrupt_last else ''})",
             attempts=attempts, pages=n_pages)
-        raise self.tracer.attach(err) if trc.enabled else err
+
+
+class PageReshard(PageTransfer):
+    """The device-to-device handoff tier: the same verified channel as
+    :class:`PageTransfer` but over the spec-to-spec ICI/DCN link
+    instead of the host bounce. Pass the source/destination sub-meshes
+    (``partition.mesh.make_mesh`` slices) and the tile pair moves
+    through :func:`make_tile_transfer_fns` on each side — gather under
+    the source mesh's TP spec, place under the destination's; on the
+    single-process rig both default to the degenerate host round-trip,
+    which keeps CPU chaos tests byte-faithful while exercising every
+    fault path. Budget exhaustion raises the typed
+    :class:`~apex_tpu.serving.health.ReshardFailed` (corrupt or
+    dropped — ``corrupt`` tells which); the pool router catches it and
+    re-ships the same pages through its host-staged
+    :class:`PageTransfer`, so the reshard tier degrades to the r15
+    contract instead of failing a request."""
+
+    send_site = "reshard_send"
+    recv_site = "reshard_recv"
+    span = "reshard"
+    stat_prefix = "reshard"
+    delivered_stat = "reshards"
+    metric_prefix = "serving_reshard"
+
+    def __init__(self, injector: Optional[FaultInjector] = None,
+                 tracer: Optional[Tracer] = None,
+                 stats: Optional[ServingStats] = None,
+                 max_retries: int = 2,
+                 src_mesh=None, dst_mesh=None):
+        gather_fn, shard_fn = _default_gather, _default_shard
+        if src_mesh is not None:
+            gather_fn, _ = make_tile_transfer_fns(src_mesh)
+        if dst_mesh is not None:
+            _, shard_fn = make_tile_transfer_fns(dst_mesh)
+        super().__init__(injector=injector, tracer=tracer, stats=stats,
+                         max_retries=max_retries, gather_fn=gather_fn,
+                         shard_fn=shard_fn)
+
+    def _budget_error(self, replica: str, attempts: int, n_pages: int,
+                      corrupt_last: bool):
+        return ReshardFailed(
+            f"device-to-device reshard from replica {replica!r} lost "
+            f"all {attempts} attempts ({n_pages} pages"
+            f"{'; last payload corrupt' if corrupt_last else ''}) — "
+            "degrading to host-staged handoff",
+            attempts=attempts, pages=n_pages, corrupt=corrupt_last)

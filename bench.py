@@ -849,6 +849,71 @@ def bench_gpt_serving_scenarios(on_tpu):
                               "error": repr(e)[:200]}), flush=True)
 
 
+def bench_gpt_serving_pool(on_tpu):
+    """Driver config ``serving_pool_scaling``: the long_context
+    seeded-Poisson mix replayed through replica pools of growing
+    shape — 1x1, 2x1, 2x2 prefill x decode — one line per shape with
+    GOODPUT (committed tokens per scheduler tick) plus registry-derived
+    TTFT/ITL percentiles. The pool's link-overlap clock charges each
+    admission pass only the reshard horizon it EXTENDS, so a second
+    prefill replica absorbs concurrent handoffs for free and goodput
+    must be monotonically non-decreasing up the sweep — asserted, not
+    just reported, right after the committed streams are asserted
+    bit-identical across every shape (scale may only move the clock)."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (FaultInjector, PagedDecodeEngine,
+                                  PoolRouter, Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+
+    def engine(trc, inj):
+        return PagedDecodeEngine(params, cfg, num_slots=2, max_len=64,
+                                 num_pages=48, page_size=4,
+                                 buckets=(16, 64), tracer=trc,
+                                 injector=inj)
+
+    results = []                       # (shape, streams, goodput)
+    for n_prefill, n_decode in ((1, 1), (2, 1), (2, 2)):
+        shape = f"{n_prefill}x{n_decode}"
+        metric = f"gpt_serving_pool_{shape}_goodput"
+        try:
+            trc = Tracer()
+            inj = FaultInjector()      # one injector, shared — inert
+            sched = PoolRouter(
+                [engine(trc, inj) for _ in range(n_prefill)],
+                [engine(trc, inj) for _ in range(n_decode)],
+                eos_id=-1)
+            arrivals = _scenario_arrivals("long_context",
+                                          cfg.vocab_size)
+            streams = _drive_poisson(sched, arrivals)
+            tokens = sum(len(s) for s in streams)
+            goodput = tokens / max(1, sched.clock)
+            lat = trc.latency_summary()
+            if results:
+                assert streams == results[0][1], \
+                    f"pool {shape} streams diverged from 1x1"
+                assert goodput >= results[-1][2] - 1e-12, \
+                    (f"goodput regressed {results[-1][0]} -> {shape}: "
+                     f"{results[-1][2]:.4f} -> {goodput:.4f}")
+            results.append((shape, streams, goodput))
+            extra = {"seed": _SCENARIO_SEED["long_context"],
+                     "requests": len(arrivals), "tokens": tokens,
+                     "clock_ticks": sched.clock,
+                     "reshards": sched.stats.reshards,
+                     "transfers": sched.stats.transfers,
+                     "remote_prefills": sched.stats.remote_prefills}
+            extra.update(lat)
+            _maybe_dump_trace(trc, f"pool_{shape}")
+            emit(metric, round(goodput, 4), "tokens/tick", extra=extra,
+                 higher_is_better=True)
+        except Exception as e:  # one shape must never sink the others
+            print(json.dumps({"metric": metric,
+                              "error": repr(e)[:200]}), flush=True)
+
+
 def _spec_decode_setup(on_tpu, spec_k, tracer=None):
     """Scheduler-driven decode over repetitive prompts (the n-gram
     drafter's home turf). Returns ``run() -> (tokens, stats)``: each
@@ -1299,6 +1364,71 @@ def _disagg_vs_colocated_ab_pair(on_tpu):
     streams_a, lat_a, sample_a = side(True)
     streams_b, lat_b, sample_b = side(False)
     assert streams_a == streams_b, "disaggregated streams diverged"
+    return sample_a, sample_b
+
+
+def _pool_2x2_vs_1x1_ab_pair(on_tpu):
+    """(side_a, side_b): a 2x2 replica pool riding the device-to-device
+    reshard tier (ICI-priced, 0.03125 ticks/page, link-overlap clock)
+    vs the single-pair router's host-staged handoff (0.125 ticks/page,
+    serial), both draining the seeded long-context mix as a CLOSED-LOOP
+    BURST (every request queued at tick 0 — an open-loop Poisson replay
+    hides the handoff charge inside idle inter-arrival gaps that
+    ``advance_clock`` jumps over), scored as TICKS PER COMMITTED
+    TOKEN — the inverse goodput, so the point ratio IS the goodput
+    ratio with the sides flipped. The
+    committed streams are asserted bit-identical between the pool and
+    the pair before either clock is read (routing, resharding and
+    placement may only move the clock), and the pool's final clock is
+    asserted <= the pair's — the per-link pricing claim (a 14-page
+    long-context prompt charges ceil(14 x 0.03125) = 1 ICI tick vs
+    ceil(14 x 0.125) = 2 host-staged ticks) made load-bearing. Both
+    sides replay identical arrivals, so the band collapses to the
+    point ratio. Ratio < 1 = the pool's resharded handoff is cheaper
+    per token."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+    from apex_tpu.serving import (DisaggregatedRouter, FaultInjector,
+                                  PagedDecodeEngine, PoolRouter,
+                                  Tracer)
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+
+    def engine(trc, inj):
+        return PagedDecodeEngine(params, cfg, num_slots=2, max_len=64,
+                                 num_pages=48, page_size=4,
+                                 buckets=(16, 64), tracer=trc,
+                                 injector=inj)
+
+    def side(pool):
+        trc = Tracer()
+        inj = FaultInjector()          # one injector, shared — inert
+        if pool:
+            sched = PoolRouter([engine(trc, inj) for _ in range(2)],
+                               [engine(trc, inj) for _ in range(2)],
+                               eos_id=-1)
+        else:
+            sched = DisaggregatedRouter(engine(trc, inj),
+                                        engine(trc, inj), eos_id=-1)
+        for _, req in _scenario_arrivals("long_context",
+                                         cfg.vocab_size):
+            sched.submit(req)
+        while sched.busy:
+            sched.step()
+        streams = [list(sched.outcomes[rid].tokens)
+                   for rid in sorted(sched.outcomes)]
+        tokens = sum(len(s) for s in streams)
+        tpt = sched.clock / max(1, tokens)
+        return streams, sched.clock, (lambda: float(tpt))
+
+    streams_a, clock_a, sample_a = side(True)
+    streams_b, clock_b, sample_b = side(False)
+    assert streams_a == streams_b, "pool streams diverged from pair"
+    assert clock_a <= clock_b, \
+        (f"resharded pool clock {clock_a} exceeds host-staged pair "
+         f"clock {clock_b}: per-link pricing regressed")
     return sample_a, sample_b
 
 
@@ -1957,6 +2087,9 @@ AB_PAIRS = {
     "serving_disagg_vs_colocated": (
         "disagg_router", "colocated",
         _disagg_vs_colocated_ab_pair),
+    "serving_pool_2x2_vs_1x1": (
+        "pool_2x2", "disagg_1x1",
+        _pool_2x2_vs_1x1_ab_pair),
     "prefix_host_hit_vs_reprefill": (
         "host_tier_hit", "reprefill",
         _host_hit_vs_reprefill_ab_pair),
@@ -2423,6 +2556,7 @@ CONFIGS = {
     "gpt_decode": bench_gpt_decode,
     "gpt_spec_natural": bench_gpt_spec_natural,
     "gpt_serving_scenarios": bench_gpt_serving_scenarios,
+    "serving_pool_scaling": bench_gpt_serving_pool,
 }
 
 # Driver execution order (round-4 postmortem). The HEADLINE runs FIRST:
@@ -2434,7 +2568,8 @@ CONFIGS = {
 # line is RE-EMITTED at the very end so the driver's parse-the-tail
 # convention still lands on the contract metric.
 ORDER = ["headline", "gpt_decode", "gpt_spec_natural",
-         "gpt_serving_scenarios", "kernel_parity", "flash_attention",
+         "gpt_serving_scenarios", "serving_pool_scaling",
+         "kernel_parity", "flash_attention",
          "ab_kernels", "layer_norm", "opt_adam", "opt_lamb",
          "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
 
@@ -2448,7 +2583,7 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2700"))
 CAP_S = {"headline": 600, "kernel_parity": 480, "ddp_bert": 540,
          "tp_gpt": 600, "flash_attention": 540, "ab_kernels": 540,
          "gpt_decode": 420, "gpt_spec_natural": 420,
-         "gpt_serving_scenarios": 420}
+         "gpt_serving_scenarios": 420, "serving_pool_scaling": 420}
 DEFAULT_CAP_S = 480
 
 

@@ -481,3 +481,76 @@ def test_disagg_transfer_metrics_and_replay(model):
     # tick-clock event stream, transfer spans included
     _, trc2 = go()
     assert trc.tick_stream() == trc2.tick_stream()
+
+
+def test_pool_metrics_and_replay(model):
+    """The pool tier's observability surface: one ``reshard`` span per
+    device-to-device handoff, the per-replica
+    ``serving_pool_replica_load`` gauge and per-reason
+    ``serving_pool_routing_total`` counters, per-replica labeled
+    reshard counters, and the ``rebalance`` lifecycle instant on a
+    failover placement move — all inside the event taxonomy, and the
+    whole tick-clock event stream replay-exact under a pinned fault
+    schedule (a reshard drop AND a mid-stream decode failover)."""
+    from apex_tpu.serving import PoolRouter
+    from apex_tpu.serving.health import HEALTH_STATES
+
+    def go():
+        # reshard_send 0 -> first handoff retries inside its span;
+        # replica_health 2,6 -> decode0 (probe order prefill0,
+        # prefill1, decode0, decode1) dies and the slots move to
+        # decode1
+        inj = FaultInjector(schedule={"reshard_send": (0,),
+                                      "replica_health": (2, 6)})
+        trc = Tracer()
+        pool = PoolRouter(
+            [_engine(model, trc, inj), _engine(model, trc, inj)],
+            [_engine(model, trc, inj), _engine(model, trc, inj)],
+            EOS, audit=True)
+        for s in range(3):
+            pool.submit(Request(prompt=(7, 11, 13 + s),
+                                max_new_tokens=6, temperature=0.7,
+                                seed=s))
+        pool.run()
+        return pool, trc
+
+    pool, trc = go()
+    names = {e.name for e in trc.events}
+    assert "reshard" in names
+    assert "rebalance" in names
+    assert names <= set(PHASES) | set(LIFECYCLE)
+    spans = [e for e in trc.events if e.name == "reshard"]
+    assert len(spans) == pool.stats.reshards >= 3
+    assert pool.stats.reshard_retries == 1
+    assert pool.stats.reshard_failures == 0
+    assert pool.stats.failovers == 1 and pool.stats.rebalances == 1
+    reg = trc.registry
+    # per-reason routing counters: every remote admission routed by
+    # load (no pool_route fault pinned)
+    assert reg.get("serving_pool_routing_total",
+                   labels={"reason": "load"}).value \
+        == pool.stats.remote_prefills
+    # the load gauge exists per prefill replica and ends at the last
+    # pass's link-busy value (deterministic)
+    for replica in ("prefill0", "prefill1"):
+        assert reg.get("serving_pool_replica_load",
+                       labels={"replica": replica}) is not None
+    # per-replica labeled reshard counters on the routed source
+    total_bytes = sum(
+        reg.get("serving_reshard_src_bytes_total",
+                labels={"replica": r}).value
+        for r in ("prefill0", "prefill1")
+        if reg.get("serving_reshard_src_bytes_total",
+                   labels={"replica": r}) is not None)
+    assert total_bytes > 0
+    # all four replicas publish the health gauge; decode0 took the
+    # two pinned probe hits
+    for replica in ("prefill0", "prefill1", "decode0", "decode1"):
+        g = reg.get("serving_replica_health",
+                    labels={"replica": replica})
+        assert g is not None and g.value <= HEALTH_STATES.index("healthy")
+    # replay-exactness under the pinned schedule: byte-equal tick
+    # stream, reshard spans and the rebalance instant included
+    pool2, trc2 = go()
+    assert trc.tick_stream() == trc2.tick_stream()
+    assert pool2.stats.as_dict() == pool.stats.as_dict()

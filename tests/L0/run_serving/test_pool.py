@@ -1,0 +1,385 @@
+"""Chaos tier for the pool-scale serving tier (``serving.router``
+``PoolRouter`` + ``serving.transfer`` ``PageReshard``): N prefill x M
+decode replica pools with load-based routing, device-to-device page
+resharding, and N-way failover.
+
+The load-bearing contracts:
+
+- FAULT-FREE IDENTITY — pool committed streams are integer-identical
+  to the colocated scheduler's AND to the 1x1 ``DisaggregatedRouter``'s
+  across every pool shape (1x1, 2x1, 2x2), with every admission's
+  handoff riding the device-to-device reshard tier;
+- every reshard fault degrades GRACEFULLY: retries inside the budget,
+  quarantined corruption, host-staged re-ship on exhaustion
+  (``ReshardFailed``), colocated service as the last rung — all
+  invisible in the committed token streams;
+- a ``pool_route`` fault degrades the ROUTING POLICY (fixed-order
+  pick), never the stream;
+- N-way failover walks the ladder decode sibling → borrowed prefill
+  replica → last-replica-standing, and rebalances home when a decode
+  replica recovers — committed streams stay bit-identical throughout
+  (drains resume via the preemption path);
+- the randomized multi-fault sweep replays bit-for-bit (outcomes,
+  stats, injector counts, tick-clock event stream) under ``audit=True``.
+
+``APEX_CHAOS_POOL_SEED`` (comma-separated ints) overrides the sweep's
+seed set — the CI chaos matrix fans one seed per leg and uploads each
+leg's Perfetto dump.
+"""
+
+import dataclasses
+import os
+
+import jax
+import pytest
+
+from apex_tpu.models.gpt import gpt_tiny, init_gpt
+from apex_tpu.serving import (
+    ContinuousBatchingScheduler, DisaggregatedRouter, FaultInjector,
+    PagedDecodeEngine, PageReshard, PoolRouter, PrefixRegistry, Request,
+    ReshardFailed, Tracer, FINISH_REASONS,
+)
+
+pytestmark = pytest.mark.chaos
+
+EOS = -1       # unreachable: healthy streams run to max_new_tokens
+MAX_LEN = 32
+
+#: The randomized sweep's seeds; the CI chaos matrix overrides this to
+#: one seed per leg.
+_POOL_SEEDS = tuple(
+    int(s) for s in os.environ.get("APEX_CHAOS_POOL_SEED",
+                                   "0,1,2").split(","))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                              hidden_dropout=0.0)
+    return cfg, init_gpt(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(model, injector=None, tracer=None, num_pages=20, **kw):
+    cfg, params = model
+    kw.setdefault("tracer", tracer if tracer is not None else Tracer())
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                             num_pages=num_pages, page_size=4,
+                             buckets=(16, 32), injector=injector, **kw)
+
+
+def _pool(model, n_prefill=2, n_decode=2, schedule=None, rates=None,
+          seed=0, num_pages=20, spec_k=0, **kw):
+    inj = FaultInjector(seed=seed, rates=rates, schedule=schedule)
+    trc = Tracer()
+    prefills = [_engine(model, inj, trc, num_pages=num_pages,
+                        spec_k=spec_k) for _ in range(n_prefill)]
+    decodes = [_engine(model, inj, trc, num_pages=num_pages,
+                       spec_k=spec_k) for _ in range(n_decode)]
+    return PoolRouter(prefills, decodes, EOS, audit=True, **kw)
+
+
+_REQS = [Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=8),
+         Request(prompt=(6, 7, 8), max_new_tokens=6, temperature=0.8,
+                 seed=7),
+         Request(prompt=(9, 10, 11, 12), max_new_tokens=4,
+                 temperature=1.1, seed=5)]
+
+
+def _drive(sched, reqs=_REQS):
+    for r in reqs:
+        sched.submit(r)
+    return sched.run()
+
+
+def _golden(model, reqs=_REQS, spec_k=0):
+    eng = _engine(model, spec_k=spec_k)
+    return _drive(ContinuousBatchingScheduler(eng, eos_id=EOS,
+                                              audit=True), reqs)
+
+
+def _assert_all_ok_golden(router, golden):
+    assert sorted(router.outcomes) == list(range(len(golden)))
+    for rid, out in router.outcomes.items():
+        assert out.reason in FINISH_REASONS and out.ok
+        assert list(out.tokens) == golden[rid], f"request {rid} diverged"
+
+
+# -- fault-free identity across pool shapes ----------------------------------
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 1), (2, 2)])
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_fault_free_pool_streams_match_colocated(model, shape, spec_k):
+    """The headline contract at every pool shape: greedy AND sampled
+    streams, speculation on and off, integer-identical to the
+    colocated scheduler — with every admission served by a remote
+    prefill replica over the device-to-device reshard tier (zero
+    host-staged transfers)."""
+    n_prefill, n_decode = shape
+    golden = _golden(model, spec_k=spec_k)
+    pool = _pool(model, n_prefill, n_decode, spec_k=spec_k)
+    assert _drive(pool) == golden
+    assert pool.stats.remote_prefills == len(_REQS)
+    assert pool.stats.colocated_prefills == 0
+    assert pool.stats.reshards == len(_REQS)
+    assert pool.stats.transfers == 0
+    assert pool.stats.failovers == 0
+    assert all(h.state == "healthy" for h in pool.health.values())
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_pool_matches_pair_router_streams(model):
+    """Pool streams are bit-identical to the 1x1 DisaggregatedRouter's
+    (not just to colocated): same committed tokens, same outcomes —
+    the pool only moves WHERE work runs."""
+    inj, trc = FaultInjector(), Tracer()
+    pair = DisaggregatedRouter(_engine(model, inj, trc),
+                               _engine(model, inj, trc), EOS,
+                               audit=True)
+    pair_streams = _drive(pair)
+    pool = _pool(model, 2, 2)
+    assert _drive(pool) == pair_streams
+    assert {r: o.tokens for r, o in pool.outcomes.items()} \
+        == {r: o.tokens for r, o in pair.outcomes.items()}
+
+
+def test_host_staged_pool_matches_reshard_pool(model):
+    """``use_reshard=False`` pins the pool to the host-staged channel
+    — streams are identical either way (the tiers differ only in link
+    and pricing, never in bytes)."""
+    golden = _golden(model)
+    host = _pool(model, 2, 2, use_reshard=False)
+    assert _drive(host) == golden
+    assert host.stats.transfers == len(_REQS)
+    assert host.stats.reshards == 0
+
+
+def test_cross_replica_prefix_dedup_pool_wide(model):
+    """Requests sharing a full prompt page dedup across the POOL: the
+    active decode replica registered the page at the first install,
+    so the second handoff ships one page fewer regardless of which
+    prefill replica served it."""
+    reqs = [Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=6),
+            Request(prompt=(1, 2, 3, 4, 9), max_new_tokens=6,
+                    temperature=0.8, seed=7)]
+    golden = _golden(model, reqs)
+    pool = _pool(model, 2, 2)
+    assert _drive(pool, reqs) == golden
+    assert pool.stats.transfer_pages_deduped == 1
+    assert pool.stats.remote_prefills == 2
+
+
+# -- one pinned fault per new site ------------------------------------------
+
+def test_reshard_send_fault_retries_to_golden(model):
+    """One dropped d2d send: retried inside the same reshard budget,
+    delivered on attempt 2, stream bit-identical."""
+    golden = _golden(model)
+    pool = _pool(model, schedule={"reshard_send": (0,)})
+    assert _drive(pool) == golden
+    assert pool.stats.reshard_retries == 1
+    assert pool.stats.reshard_failures == 0
+    assert pool.stats.remote_prefills == len(_REQS)
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_reshard_recv_corruption_quarantines_to_golden(model):
+    """One in-flight byte flip on the d2d link: the chain-key-bound
+    checksum catches it, the payload is quarantined, the retry
+    re-extracts clean tiles — golden equality proves no corrupt page
+    was ever attended."""
+    golden = _golden(model)
+    pool = _pool(model, schedule={"reshard_recv": (0,)})
+    assert _drive(pool) == golden
+    assert pool.stats.reshard_corrupt == 1
+    assert pool.stats.reshard_retries == 1
+    assert pool.stats.reshard_failures == 0
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_reshard_exhaustion_degrades_to_host_staged(model):
+    """Every attempt of the first reshard dropped: ReshardFailed is
+    raised, caught, and the SAME pages re-ship over the host-staged
+    channel — the admission still lands remotely (never colocated for
+    a link fault) and the stream is golden."""
+    golden = _golden(model)
+    pool = _pool(model, schedule={"reshard_send": (0, 1, 2)})
+    assert _drive(pool) == golden
+    assert pool.stats.reshard_failures == 1
+    assert pool.stats.transfers >= 1        # the host-staged re-ship
+    assert pool.stats.remote_prefills == len(_REQS)
+    assert pool.stats.colocated_prefills == 0
+    names = [e.name for e in pool.tracer.events]
+    assert "failover" in names              # the tier-degrade instant
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_reshard_exhaustion_is_typed(model):
+    """Driving the channel directly: persistent d2d drops exhaust the
+    budget with a TYPED ReshardFailed carrying attempts/pages/corrupt
+    — and a clean channel still ships the same pages afterwards."""
+    inj = FaultInjector(schedule={"reshard_send": (0, 1, 2)})
+    src = _engine(model, inj)
+    src.prefill(0, [1, 2, 3, 4, 5])
+    reshard = PageReshard(injector=inj, tracer=src.tracer,
+                          stats=src.stats, max_retries=2)
+    with pytest.raises(ReshardFailed) as ei:
+        reshard.ship(src, [1, 2, 3, 4, 5], src._slot_pages[0],
+                     replica="prefill0")
+    assert ei.value.attempts == 3 and ei.value.pages == 2
+    assert ei.value.corrupt is False
+    assert src.stats.reshard_failures == 1
+    k_tile, v_tile, attempts = reshard.ship(
+        src, [1, 2, 3, 4, 5], src._slot_pages[0], replica="prefill0")
+    assert attempts == 1 and k_tile.shape[1] == 2
+    assert src.stats.reshards == 1
+
+
+def test_pool_route_fault_falls_back_fixed_order(model):
+    """A pool_route fault degrades the load-based pick to the first
+    routable replica in fixed order — a routing-policy fault moves
+    placement, never a committed token."""
+    golden = _golden(model)
+    pool = _pool(model, schedule={"pool_route": (0,)})
+    assert _drive(pool) == golden
+    assert pool.stats.route_fallbacks == 1
+    assert pool.stats.remote_prefills == len(_REQS)
+    _assert_all_ok_golden(pool, golden)
+
+
+# -- N-way failover ladder ---------------------------------------------------
+
+def test_active_decode_down_fails_over_to_sibling(model):
+    """The active decode replica dies mid-stream (probe order is
+    prefill0, prefill1, decode0, decode1 per tick: indices 2 and 6
+    are decode0's first two probes): the slots drain and move to the
+    decode SIBLING (headroom pick), never a prefill borrow while a
+    sibling is routable — streams integer-identical to golden."""
+    golden = _golden(model)
+    pool = _pool(model, schedule={"replica_health": (2, 6)})
+    assert _drive(pool) == golden
+    assert pool.stats.failovers == 1
+    assert pool.stats.rebalances == 1
+    assert pool.engine.active_name == "decode1"
+    names = [e.name for e in pool.tracer.events]
+    assert "rebalance" in names and "preempted" in names
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_all_decode_down_borrows_prefill_then_rebalances_home(model):
+    """Both decode replicas die (decode0 at probe indices 2/6, decode1
+    at 3/7): the slots borrow a PREFILL replica (the ladder's last
+    rung before last-standing), and once a decode replica climbs back
+    up the ladder the router rebalances the slots home — streams stay
+    golden through both moves."""
+    golden = _golden(model)
+    pool = _pool(model,
+                 schedule={"replica_health": (2, 6, 3, 7)})
+    assert _drive(pool) == golden
+    assert pool.stats.failovers >= 1
+    assert pool.stats.rebalances >= 2       # the borrow + the move home
+    assert pool.engine.active_name in pool.engine.decode_names
+    _assert_all_ok_golden(pool, golden)
+
+
+def test_all_replicas_down_last_standing_keeps_serving(model):
+    """Every ladder bottoms out at once (all four replicas fail every
+    probe for the whole run): there is no routable failover target,
+    so the incumbent keeps decoding — health gates ROUTING, not
+    survival. The third request admits after the collapse and is
+    served colocated. Streams golden, outcomes typed, no hang."""
+    golden = _golden(model)
+    pool = _pool(model,
+                 schedule={"replica_health": tuple(range(0, 96))})
+    assert _drive(pool) == golden
+    assert pool.stats.failovers == 0
+    assert pool.stats.colocated_prefills >= 1
+    assert all(h.state == "down" for h in pool.health.values())
+    _assert_all_ok_golden(pool, golden)
+
+
+# -- construction contracts --------------------------------------------------
+
+def test_pool_validates_replicas_pool_wide(model):
+    cfg, params = model
+    inj, trc = FaultInjector(), Tracer()
+
+    def eng(**kw):
+        return _engine(model, kw.pop("injector", inj),
+                       kw.pop("tracer", trc), **kw)
+
+    # mixed pool geometry: the odd replica out is caught PAIRWISE even
+    # when the first prefill/decode pair agrees
+    with pytest.raises(ValueError, match="agree on page_size"):
+        odd = PagedDecodeEngine(params, cfg, num_slots=2,
+                                max_len=MAX_LEN, num_pages=20,
+                                page_size=8, buckets=(16, 32),
+                                injector=inj, tracer=trc)
+        PoolRouter([eng(), eng()], [eng(), odd], EOS)
+    # mixed host tiers: the shared-PrefixRegistry-or-none rule is
+    # pool-wide, not per-pair
+    with pytest.raises(ValueError, match="ONE PrefixRegistry"):
+        tier = PrefixRegistry(capacity_bytes=1 << 20)
+        PoolRouter([eng(host_tier=tier), eng()],
+                   [eng(host_tier=tier), eng(host_tier=tier)], EOS)
+    # a repeated engine instance anywhere in the pool
+    with pytest.raises(ValueError, match="two engine instances"):
+        e = eng()
+        PoolRouter([e, eng()], [eng(), e], EOS)
+    with pytest.raises(ValueError, match="ONE FaultInjector"):
+        PoolRouter([eng(injector=FaultInjector()), eng()],
+                   [eng(), eng()], EOS)
+    with pytest.raises(ValueError, match="ONE Tracer"):
+        PoolRouter([eng(), eng()], [eng(), eng(tracer=Tracer())], EOS)
+    with pytest.raises(ValueError, match="chunked prefill"):
+        PoolRouter([eng()], [eng()], EOS, chunk_tokens=4)
+    with pytest.raises(ValueError, match="at least one"):
+        PoolRouter([], [eng()], EOS)
+    with pytest.raises(ValueError, match="placement names unknown"):
+        PoolRouter([eng(), eng()], [eng(), eng()], EOS,
+                   placement={"prefill9": 1})
+
+
+# -- randomized multi-fault sweep -------------------------------------------
+
+@pytest.mark.parametrize("seed", _POOL_SEEDS)
+def test_multi_fault_pool_chaos_replays_bit_for_bit(model, seed):
+    """Every pool site armed at once (reshard drop/corrupt, routing
+    faults, replica health, plus host-tier and decode cross-talk),
+    audited every tick: every outcome typed, every ok stream exactly
+    golden, every degraded stream a golden prefix — and the whole run
+    replays bit-for-bit: outcomes, stats, injector counts, and the
+    tick-clock event stream."""
+    golden = _golden(model)
+    rates = {"reshard_send": 0.25, "reshard_recv": 0.2,
+             "pool_route": 0.15, "replica_health": 0.1,
+             "page_send": 0.1, "decode_exec": 0.05}
+
+    def chaos_run():
+        pool = _pool(model, rates=rates, seed=seed)
+        _drive(pool)
+        return pool
+
+    pool = chaos_run()
+    assert sorted(pool.outcomes) == list(range(len(_REQS)))
+    for rid, out in pool.outcomes.items():
+        assert out.reason in FINISH_REASONS
+        want = golden[rid]
+        if out.ok:
+            assert list(out.tokens) == want, f"request {rid} diverged"
+        else:
+            assert list(out.tokens) == want[:len(out.tokens)], \
+                f"request {rid}: degraded stream not a golden prefix"
+    replay = chaos_run()
+    assert replay.outcomes == pool.outcomes
+    assert replay.stats.as_dict() == pool.stats.as_dict()
+    assert replay.engine.injector.counts == pool.engine.injector.counts
+    assert replay.tracer.tick_stream() == pool.tracer.tick_stream()
+    assert {h.state for h in replay.health.values()} \
+        == {h.state for h in pool.health.values()}
+    # CI post-mortem artifact: one Perfetto dump per sweep seed,
+    # uploaded by the chaos workflow legs
+    out_path = os.environ.get("APEX_CHAOS_TRACE_OUT")
+    if out_path:
+        root, ext = os.path.splitext(out_path)
+        pool.tracer.dump_jsonl(
+            f"{root}.pool_seed{seed}{ext or '.jsonl'}")
