@@ -95,6 +95,28 @@ CODES = {
     "APX704": "rule-generated shard_map body fails per-rank schedule "
               "agreement (APX511 simulator) or its collective volume "
               "diverges from the budgets.json record",
+    "APX801": "nondeterministic ordering on the serving tick path: "
+              "set iteration flowing into scheduling/requeue/commit "
+              "order, a set rendered into error text, unseeded "
+              "random, hash()/id() ordering keys, or a wall-clock "
+              "read outside the Tracer wall-stamp allowlist",
+    "APX802": "fault-site contract incomplete or stale: a "
+              "faults.SITES entry missing its consultation call "
+              "site, typed degrade error, chaos-test reference, or "
+              "CI sweep env — or a stale name in SITE_CONTRACTS, "
+              "tests, or the ci.yml chaos matrix",
+    "APX803": "error-taxonomy closure: a tick-path raise that is not "
+              "a ServingError taxonomy class (or allowlisted "
+              "constructor-time guard), or a taxonomy class no test "
+              "references",
+    "APX804": "observe-name drift: a tracer span/instant name "
+              "missing from PHASES/LIFECYCLE, a dynamic name at an "
+              "emit site, or a metric read-back no creation site "
+              "matches",
+    "APX805": "RNG key indiscipline on the tick path: raw PRNGKey "
+              "consumption, jax.random.split trees, or a key "
+              "consumed by more than one call instead of fold_in("
+              "seed, counter) chains",
 }
 
 

@@ -48,6 +48,12 @@ def main(argv=None) -> int:
                     help="also run the APX7xx sharding tier: "
                          "partition-rule table coverage/consistency "
                          "and rule-staged shard_map verification")
+    ap.add_argument("--determinism", action="store_true",
+                    help="also run the APX8xx determinism tier: "
+                         "tick-path ordering/RNG/clock discipline, "
+                         "fault-contract coverage, error-taxonomy "
+                         "closure, and observe-name coherence over "
+                         "the serving stack (pure AST, no jax)")
     ap.add_argument("--report", action="store_true",
                     help="with --cost: print the per-entry cost table "
                          "as JSON to stdout (findings go to stderr)")
@@ -69,8 +75,9 @@ def main(argv=None) -> int:
                          "against the catalogue (e.g. APX511,APX70*); "
                          "the tiers owning the matched codes (--trace "
                          "for APX5xx, --cost for APX6xx, --sharding "
-                         "for APX7xx) are enabled automatically and "
-                         "only the matched codes are reported")
+                         "for APX7xx, --determinism for APX8xx) are "
+                         "enabled automatically and only the matched "
+                         "codes are reported")
     ap.add_argument("--include-fixtures", action="store_true",
                     help="also lint files marked '# apxlint: fixture'")
     ap.add_argument("--list-codes", action="store_true",
@@ -138,6 +145,8 @@ def main(argv=None) -> int:
             args.cost = True
         if any(c.startswith("APX7") for c in chosen):
             args.sharding = True
+        if any(c.startswith("APX8") for c in chosen):
+            args.determinism = True
         select = chosen if select is None else (select & chosen)
 
     paths = args.paths or ["apex_tpu"]
@@ -148,6 +157,7 @@ def main(argv=None) -> int:
                                    trace_registry=args.trace,
                                    cost_registry=args.cost,
                                    sharding_registry=args.sharding,
+                                   determinism=args.determinism,
                                    cost_report_out=reports,
                                    select=select)
     # in --report mode stdout carries ONLY the JSON table (CI pipes it

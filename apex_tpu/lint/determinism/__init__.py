@@ -1,0 +1,62 @@
+"""apxlint determinism tier (APX8xx) — static race/nondeterminism
+detection and fault-contract coverage for the serving stack.
+
+The serving contract since PR 5 is that committed streams are
+bit-identical to golden through every scheduling, speculation,
+handoff, failover, and fault path — but it is enforced only
+dynamically, by chaos tests that can miss a nondeterminism source
+until a seed happens to hit it. This tier verifies the contract's
+statically checkable preconditions the way APX511/704 verify
+collective schedules: an AST pass over every file in a ``serving``
+directory, scoped to functions reachable from the tick/admission
+roots (:mod:`.reach`). Five codes:
+
+- **APX801** (:mod:`.ordering`) — nondeterministic ordering on the
+  tick path: set iteration flowing into scheduling/requeue/commit
+  order, sets rendered into error text, unseeded stdlib RNG,
+  ``hash()``/``id()`` ordering keys, wall-clock reads outside the
+  Tracer's allowlisted wall-stamp sites.
+- **APX802** (:mod:`.contracts`) — every ``faults.SITES`` entry
+  carries its full five-artifact contract (consultation site, typed
+  degrade error, chaos-test reference, CI sweep env) via the
+  ``SITE_CONTRACTS`` table, with stale names flagged in both
+  directions.
+- **APX803** (:mod:`.taxonomy`) — tick-path raises are ServingError
+  taxonomy classes (or allowlisted constructor guards), and every
+  taxonomy class is referenced by at least one test.
+- **APX804** (:mod:`.coherence`) — tracer span/instant names resolve
+  against ``observe.PHASES``/``LIFECYCLE``, metric read-backs resolve
+  against creation sites, no drifting dynamic names.
+- **APX805** (:mod:`.rng`) — sampling keys derive via
+  ``fold_in(seed, counter)`` chains: no raw ``PRNGKey`` consumption,
+  no ``jax.random.split`` trees, no key reuse on the tick path.
+
+Run with ``python -m apex_tpu.lint --determinism`` (or any
+``--codes 'APX8*'`` selection, which enables the tier implicitly).
+Pure-AST: no jax import, no execution of the linted code.
+"""
+
+import ast
+from typing import Dict, List
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.determinism import (contracts, coherence, ordering,
+                                       rng, taxonomy)
+from apex_tpu.lint.determinism.reach import serving_trees
+
+
+def check_files(trees: Dict[str, ast.Module]) -> List[Finding]:
+    """All APX8xx findings over the serving-scope subset of ``trees``."""
+    strees = serving_trees(trees)
+    if not strees:
+        return []
+    findings: List[Finding] = []
+    findings.extend(ordering.check_files(strees))
+    findings.extend(contracts.check_files(strees))
+    findings.extend(taxonomy.check_files(strees))
+    findings.extend(coherence.check_files(strees))
+    findings.extend(rng.check_files(strees))
+    return findings
+
+
+__all__ = ["check_files", "serving_trees"]

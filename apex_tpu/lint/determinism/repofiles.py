@@ -1,0 +1,60 @@
+"""Repo-layout discovery shared by the cross-artifact determinism
+checks (APX802 fault contracts, APX803 taxonomy test coverage).
+
+Those checks compare the serving scope against artifacts OUTSIDE the
+linted file set — the chaos tests under ``tests/`` and the CI chaos
+matrix in ``.github/workflows/ci.yml``. The repo root is derived from
+the serving directory itself (``<root>/apex_tpu/serving`` → two
+levels up), which makes the same code work on the real repo, on the
+fixture mini-repos (``<fixture>/apex_tpu/serving``), and on the
+seeded-bug scratch copies the meta-tests build under a tmpdir.
+"""
+
+import os
+from typing import Dict, Optional
+
+_TEXT_CACHE: Dict[str, Dict[str, str]] = {}
+
+
+def repo_root(serving_path: str) -> str:
+    """``<root>/apex_tpu/serving`` (or any ``<root>/<pkg>/serving``)
+    → ``<root>``. A bare ``serving/`` dir resolves to its parent."""
+    parent = os.path.dirname(serving_path)
+    return os.path.dirname(parent) if parent else os.curdir
+
+
+def test_texts(root: str) -> Optional[Dict[str, str]]:
+    """path -> source text for every ``.py`` under ``<root>/tests``;
+    None when the tree has no tests directory at all (the caller
+    decides whether that is itself a finding)."""
+    key = os.path.abspath(root)
+    if key in _TEXT_CACHE:
+        return _TEXT_CACHE[key] or None
+    tests = os.path.join(root, "tests")
+    if not os.path.isdir(tests):
+        _TEXT_CACHE[key] = {}
+        return None
+    out: Dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(tests):
+        dirnames.sort()
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    out[path] = fh.read()
+            except OSError:
+                continue
+    _TEXT_CACHE[key] = out
+    return out
+
+
+def ci_text(root: str) -> Optional[str]:
+    """The CI workflow text, or None when the tree has none."""
+    path = os.path.join(root, ".github", "workflows", "ci.yml")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None

@@ -32,7 +32,12 @@ Checks come in two shapes:
 - the sharding tier (``sharding_registry=True`` / CLI ``--sharding``)
   walks the ``apex_tpu.lint.sharded`` entry registry: partition-rule
   table coverage, cross-tree spec consistency, and rule-staged
-  shard_map verification (APX701-704, same line-1 attribution).
+  shard_map verification (APX701-704, same line-1 attribution);
+- the determinism tier (``determinism=True`` / CLI ``--determinism``)
+  is a project check like ``hygiene``: a pure-AST pass over the
+  serving-scope files (any ``serving/`` directory in the linted set)
+  checking tick-path ordering, fault-contract coverage, taxonomy
+  closure, observe coherence, and RNG key discipline (APX801-805).
 """
 
 import ast
@@ -132,6 +137,7 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
                trace: bool = True, trace_registry: bool = False,
                cost_registry: bool = False,
                sharding_registry: bool = False,
+               determinism: bool = False,
                cost_report_out: Optional[list] = None,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
@@ -163,6 +169,10 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
     findings.extend(amp_lists.check_files(trees))
     from apex_tpu.lint import meta
     findings.extend(meta.check_files(trees))
+    if determinism:
+        # pure-AST like hygiene/meta — no jax import, no execution
+        from apex_tpu.lint import determinism as det
+        findings.extend(det.check_files(trees))
     if trace or trace_registry or cost_registry or sharding_registry:
         # must precede first backend touch: the sharded entries (vmem's
         # bottleneck config, the trace tier's mesh entries) need the

@@ -115,6 +115,36 @@ SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
          "replica_health", "host_spill", "host_promote", "reshard_send",
          "reshard_recv", "pool_route")
 
+#: Per-site contract: ``site -> (typed degrade error | None,
+#: CI chaos-matrix sweep env | None)``. The error is the
+#: ``ServingError`` subclass (or :class:`InjectedFault`) the site's
+#: degrade path raises when its budget/ladder is exhausted — ``None``
+#: for policy-only faults that alter a decision instead of raising
+#: (``pool_route`` falls back to fixed-order routing). The sweep env
+#: is the seed variable a CI chaos-matrix leg fans for the site's
+#: family — ``None`` for sites exercised by the default deterministic
+#: schedules in every leg. apxlint APX802 cross-checks this table
+#: against the consultation call sites, the taxonomy, the chaos
+#: tests, and ``ci.yml`` in both directions; keep it in lockstep with
+#: :data:`SITES` and the table above.
+SITE_CONTRACTS = {
+    "pool_alloc": ("PoolExhausted", None),
+    "cow_clone": ("PoolExhausted", None),
+    "prefill_exec": ("InjectedFault", None),
+    "chunk_prefill_exec": ("InjectedFault", None),
+    "decode_exec": ("NonFiniteLogits", None),
+    "sample": ("NonFiniteLogits", None),
+    "draft_exec": ("InjectedFault", None),
+    "page_send": ("TransferFailed", "APEX_CHAOS_TRANSFER_SEED"),
+    "page_recv": ("TransferCorrupt", "APEX_CHAOS_TRANSFER_SEED"),
+    "replica_health": ("ReplicaUnavailable", "APEX_CHAOS_TRANSFER_SEED"),
+    "host_spill": ("SpillFailed", "APEX_CHAOS_SPILL_SEED"),
+    "host_promote": ("PromoteFailed", "APEX_CHAOS_SPILL_SEED"),
+    "reshard_send": ("ReshardFailed", "APEX_CHAOS_POOL_SEED"),
+    "reshard_recv": ("ReshardFailed", "APEX_CHAOS_POOL_SEED"),
+    "pool_route": (None, "APEX_CHAOS_POOL_SEED"),
+}
+
 
 class InjectedFault(RuntimeError):
     """A simulated transient failure (site ``prefill_exec``). The

@@ -45,7 +45,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
-#: Per-tick phase spans, in tick order. ``exec`` covers the jitted
+#: Per-tick phase spans, in tick order. ``prefill`` one jitted
+#: whole-prompt forward at admission; ``exec`` covers the jitted
 #: decode / verify / tree-verify dispatch inside the engine;
 #: ``chunk_prefill`` one jitted prompt-chunk forward (several may run
 #: per tick, one span each); ``page_transfer`` one host-staged
@@ -53,17 +54,20 @@ from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 #: retries included in the span); ``reshard`` one device-to-device
 #: spec-to-spec page reshard (``serving.transfer.PageReshard`` — the
 #: pool router's default handoff); the rest are host-side scheduler
-#: phases.
-PHASES = ("draft", "prepare_decode", "exec", "accept", "commit",
-          "chunk_prefill", "page_transfer", "reshard")
+#: phases. apxlint APX804 resolves every ``begin``/``end`` emit site
+#: against this tuple.
+PHASES = ("prefill", "draft", "prepare_decode", "exec", "accept",
+          "commit", "chunk_prefill", "page_transfer", "reshard")
 
 #: Per-request lifecycle instants. ``host_spill`` / ``host_promote``
 #: mark KV pages crossing the HBM <-> host-tier boundary (one instant
 #: per spilled page / per promoted chain, ``ok=False`` on a fault or
 #: verification failure); ``rebalance`` marks the pool router moving
 #: decode placement onto a sibling replica (the N-way failover pick,
-#: chosen by pages-free headroom).
-LIFECYCLE = ("submitted", "admitted", "prefill", "first_token",
+#: chosen by pages-free headroom). (``prefill`` is a SPAN, not an
+#: instant — it lives in :data:`PHASES`; apxlint APX804 resolves
+#: every ``instant`` emit site against this tuple.)
+LIFECYCLE = ("submitted", "admitted", "first_token",
              "preempted", "retried", "quarantined", "failover",
              "finished", "host_spill", "host_promote", "rebalance")
 

@@ -546,7 +546,7 @@ class PagePool:
                 expected.update(pages)
             if dict(expected) != self._ref:
                 diff = {p: (expected.get(p, 0), self._ref.get(p, 0))
-                        for p in set(expected) | set(self._ref)
+                        for p in sorted(set(expected) | set(self._ref))
                         if expected.get(p, 0) != self._ref.get(p, 0)}
                 raise PoolInvariantError(
                     "refcounts out of balance (page: expected slot+"

@@ -1659,7 +1659,7 @@ class ContinuousBatchingScheduler:
             else:
                 p.next += self.chunk_tokens
                 order.append(i)
-        for rid in progressed:
+        for rid in sorted(progressed):
             self._prefill_ticks[rid] = \
                 self._prefill_ticks.get(rid, 0) + 1
 

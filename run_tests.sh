@@ -24,17 +24,19 @@
 #                           #     serving tiers (w8 / kv8 / w8+kv8) must
 #                           #     track the trained fp32 eval-NLL curve
 #                           #     — run on every PR
-#   ./run_tests.sh lint     # apxlint, all four tiers: AST contract
+#   ./run_tests.sh lint     # apxlint, all five tiers: AST contract
 #                           #     checks (kernel aliasing, collectives,
 #                           #     AMP lists, hygiene), the VMEM budget
 #                           #     pass, the jaxpr trace tier (APX5xx)
 #                           #     over the entry registry, the cost
-#                           #     tier (APX6xx byte budgets), and the
+#                           #     tier (APX6xx byte budgets), the
 #                           #     sharding tier (APX7xx partition-rule
-#                           #     contracts) — blocking in CI, with a
-#                           #     combined wall-time budget enforced so
-#                           #     the gate stays fast enough to run on
-#                           #     every push
+#                           #     contracts), and the determinism tier
+#                           #     (APX8xx serving-stack race/ordering +
+#                           #     fault-contract coverage) — blocking in
+#                           #     CI, with a combined wall-time budget
+#                           #     enforced so the gate stays fast enough
+#                           #     to run on every push
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -64,14 +66,14 @@ case "$tier" in
          exec python -m pytest tests -q -m chaos "$@" ;;
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py \
              tests/L1/test_quant_eval_parity.py -q "$@" ;;
-  lint)  # combined AST + VMEM + trace + cost + sharding tiers, under a
-         # wall-time budget: a slow lint gate stops being run, so
-         # exceeding the budget is itself a failure (trim the entry
-         # registry or speed it up)
+  lint)  # combined AST + VMEM + trace + cost + sharding + determinism
+         # tiers, under a wall-time budget: a slow lint gate stops
+         # being run, so exceeding the budget is itself a failure (trim
+         # the entry registry or speed it up)
          budget=90
          start=$SECONDS
          python -m apex_tpu.lint apex_tpu tests --trace --cost \
-             --sharding "$@"
+             --sharding --determinism "$@"
          elapsed=$(( SECONDS - start ))
          if (( elapsed > budget )); then
            echo "apxlint: combined run took ${elapsed}s," \

@@ -1,0 +1,6 @@
+"""Keep pytest out of the lint fixtures: the determinism-tier fixture
+mini-repos contain files named ``test_*.py`` (the APX802/APX803
+cross-artifact checks read test *text*, so the fixtures ship fake test
+files), which are lint inputs, not collectible tests."""
+
+collect_ignore = ["fixtures"]

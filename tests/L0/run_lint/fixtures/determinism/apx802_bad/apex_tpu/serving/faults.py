@@ -1,0 +1,22 @@
+# apxlint: fixture
+"""Known-bad APX802: the site table drifts from its five artifacts in
+every direction the checker covers."""
+SITES = ("alpha_exec", "beta_send", "gamma_probe")
+
+SITE_CONTRACTS = {
+    "alpha_exec": ("AlphaError", None),       # AlphaError: undefined
+    "beta_send": ("BetaFailed", "APEX_CHAOS_BETA_SEED"),
+    "stale_site": (None, None),               # not in SITES
+}
+# gamma_probe: missing from SITE_CONTRACTS, never consulted, never
+# referenced by a chaos test
+
+
+class BetaFailed(RuntimeError):
+    pass
+
+
+class Hooks:
+    def run(self):
+        self.injector.draw("alpha_exec")
+        self.injector.fire("beta_send")

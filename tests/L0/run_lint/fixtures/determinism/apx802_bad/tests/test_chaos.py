@@ -1,0 +1,7 @@
+# apxlint: fixture
+"""chaos fixture suite: references alpha_exec only — beta_send and
+gamma_probe have no chaos coverage, and nothing reads the sweep env."""
+
+
+def test_alpha(injector):
+    assert injector.draw("alpha_exec")

@@ -1,0 +1,19 @@
+# apxlint: fixture
+"""Known-clean APX802 twin: two sites, five artifacts each, all in
+lockstep."""
+SITES = ("alpha_exec", "beta_send")
+
+SITE_CONTRACTS = {
+    "alpha_exec": (None, None),               # policy-only fault
+    "beta_send": ("BetaFailed", "APEX_CHAOS_BETA_SEED"),
+}
+
+
+class BetaFailed(RuntimeError):
+    pass
+
+
+class Hooks:
+    def run(self):
+        self.injector.draw("alpha_exec")
+        self.injector.fire("beta_send")

@@ -1,0 +1,10 @@
+# apxlint: fixture
+"""chaos fixture suite: every site replayed, the sweep env read."""
+import os
+
+SEED = int(os.environ.get("APEX_CHAOS_BETA_SEED", "0"))
+
+
+def test_sites(injector):
+    assert injector.draw("alpha_exec")
+    assert injector.fire("beta_send")

@@ -1,0 +1,10 @@
+# apxlint: fixture
+"""Known-bad APX803 coverage twin: GhostError has no test reference."""
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class GhostError(ServingError):
+    pass

@@ -1,0 +1,6 @@
+# apxlint: fixture
+from health import ServingError
+
+
+def test_base():
+    assert issubclass(ServingError, RuntimeError)

@@ -1,0 +1,10 @@
+# apxlint: fixture
+"""Known-clean APX803 coverage twin: every taxonomy class tested."""
+
+
+class ServingError(RuntimeError):
+    pass
+
+
+class GhostError(ServingError):
+    pass

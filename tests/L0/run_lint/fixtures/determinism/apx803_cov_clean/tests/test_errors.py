@@ -1,0 +1,6 @@
+# apxlint: fixture
+from health import GhostError, ServingError
+
+
+def test_taxonomy():
+    assert issubclass(GhostError, ServingError)

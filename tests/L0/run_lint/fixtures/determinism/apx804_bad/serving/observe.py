@@ -1,0 +1,4 @@
+# apxlint: fixture
+"""Declared vocabulary for the APX804 bad twin."""
+PHASES = ("exec", "commit")
+LIFECYCLE = ("submitted", "finished")

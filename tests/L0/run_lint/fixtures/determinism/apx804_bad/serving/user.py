@@ -1,0 +1,15 @@
+# apxlint: fixture
+"""Known-bad APX804: spans/instants/metrics drifting from the
+declared vocabulary."""
+
+
+class Chan:
+    span = "teleport"                       # not in PHASES
+
+    def run(self, trc, reg, name):
+        trc.begin("warmup")                 # span missing from PHASES
+        trc.end("warmup")                   # ditto at the close
+        trc.instant("midpoint")             # instant missing from LIFECYCLE
+        trc.begin(name)                     # dynamic emit-site name
+        reg.counter("serving_ok_total", help="fixture")
+        return reg.get("serving_missing_total")   # never-created metric

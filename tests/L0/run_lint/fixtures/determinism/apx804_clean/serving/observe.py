@@ -1,0 +1,4 @@
+# apxlint: fixture
+"""Declared vocabulary for the APX804 clean twin."""
+PHASES = ("exec", "commit", "teleport")
+LIFECYCLE = ("submitted", "midpoint", "finished")

@@ -1,0 +1,16 @@
+# apxlint: fixture
+"""Known-clean APX804 twin: every emit site resolves against the
+declared tuples; the read-back matches a creation site."""
+
+
+class Chan:
+    span = "teleport"
+
+    def run(self, trc, reg):
+        trc.begin("exec")
+        trc.end("exec")
+        trc.begin(self.span)                # declared span attribute
+        trc.end(self.span)
+        trc.instant("midpoint")
+        reg.counter("serving_ok_total", help="fixture")
+        return reg.get("serving_ok_total")

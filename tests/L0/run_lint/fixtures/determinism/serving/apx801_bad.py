@@ -1,0 +1,20 @@
+# apxlint: fixture
+"""Known-bad APX801: every flavor of nondeterministic ordering on the
+tick path — set iteration/materialization, set-in-text, wall clock,
+unseeded random, hash()."""
+import random
+import time
+
+
+class Sched:
+    def run(self, n):
+        pending = set(range(n))
+        order = []
+        for rid in pending:                     # set iteration
+            order.append(rid)
+        ready = [r for r in pending]            # comprehension source
+        first = list(pending)                   # order-materializing call
+        started = time.time()                   # wall clock on tick path
+        jitter = random.random()                # unseeded stdlib RNG
+        bucket = hash(order[0])                 # process-dependent value
+        raise ValueError(f"stuck requests {pending}")   # set in text

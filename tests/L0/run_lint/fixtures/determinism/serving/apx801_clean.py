@@ -1,0 +1,15 @@
+# apxlint: fixture
+"""Known-clean APX801 twin: same shapes, deterministic order — sorted
+materialization, order-free set consumers, no host entropy."""
+
+
+class Sched:
+    def run(self, n, tick):
+        pending = set(range(n))
+        order = []
+        for rid in sorted(pending):             # sorted: committed order
+            order.append(rid)
+        if n in pending:                        # membership: order-free
+            depth = len(pending)                # size: order-free
+        busy = pending & {0, 1}                 # set algebra: stays a set
+        raise ValueError(f"stuck requests {sorted(pending)}")

@@ -1,0 +1,9 @@
+# apxlint: fixture
+"""Known-bad APX803: an untyped raise on the tick path falls through
+every degrade ladder."""
+
+
+class Sched:
+    def run(self):
+        if not self._slots:
+            raise RuntimeError("no slots configured")

@@ -1,0 +1,20 @@
+# apxlint: fixture
+"""Known-clean APX803 twin: taxonomy subclass for the degrade path,
+allowlisted ValueError for constructor-time validation, re-raise."""
+from apex_tpu.serving.health import ServingError
+
+
+class SlotsExhausted(ServingError):
+    pass
+
+
+class Sched:
+    def run(self):
+        if not self._slots:
+            raise SlotsExhausted("no slots configured")
+        if self._chunk_tokens < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        try:
+            self._tick()
+        except SlotsExhausted as err:
+            raise err

@@ -1,0 +1,13 @@
+# apxlint: fixture
+"""Known-bad APX805: raw PRNGKey consumption, key reuse, and a split
+tree on the tick path."""
+import jax
+
+
+class Engine:
+    def step(self, seed, logits):
+        key = jax.random.PRNGKey(seed)           # raw key, never folded
+        a = jax.random.categorical(key, logits)  # first consumer
+        b = jax.random.categorical(key, logits)  # reuse: correlated draw
+        k1, k2 = jax.random.split(key)           # split tree
+        return a, b, k1, k2

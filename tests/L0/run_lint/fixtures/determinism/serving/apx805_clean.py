@@ -1,0 +1,13 @@
+# apxlint: fixture
+"""Known-clean APX805 twin: per-slot keys derived as
+fold_in(PRNGKey(request seed), position counter), batched by stack."""
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def step(self, seeds, counter, logits):
+        keys = jnp.stack(
+            [jax.random.fold_in(jax.random.PRNGKey(s), counter)
+             for s in seeds])
+        return jax.random.categorical(keys, logits)

@@ -1,0 +1,17 @@
+# apxlint: fixture
+"""APX8xx suppression: same violations as the bad fixtures, silenced
+line-by-line through the shared engine machinery."""
+import jax
+
+
+class Sched:
+    def run(self, n, seed, logits):
+        pending = set(range(n))
+        for rid in pending:  # apxlint: disable=APX801
+            self._visit(rid)
+        # apxlint: disable=APX805
+        key = jax.random.PRNGKey(seed)
+        if not pending:
+            # apxlint: disable=APX803
+            raise RuntimeError("no slots configured")
+        return jax.random.categorical(key, logits)

@@ -30,6 +30,7 @@ def test_codes_registry_complete():
         "APX511", "APX512",
         "APX601", "APX602", "APX603", "APX604",
         "APX701", "APX702", "APX703", "APX704",
+        "APX801", "APX802", "APX803", "APX804", "APX805",
     }
     assert all(CODES[c] for c in CODES)  # every code documented
 

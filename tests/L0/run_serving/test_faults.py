@@ -613,3 +613,46 @@ def test_multi_fault_chaos_on_int8_pool(model):
     assert replay.outcomes == sched.outcomes
     assert replay.stats.as_dict() == sched.stats.as_dict()
     assert replay.engine.injector.counts == sched.engine.injector.counts
+
+
+def test_error_taxonomy_contract():
+    """Every SITE_CONTRACTS degrade error is a real taxonomy class,
+    every taxonomy class carries the payload contract, and the table
+    covers SITES exactly — the static half of what apxlint APX802/
+    APX803 verify, exercised live so a rename breaks a test before it
+    breaks the lint."""
+    from apex_tpu.serving import (
+        InjectedFault, NonFiniteLogits, PromoteFailed,
+        ReplicaUnavailable, ServingError, SpillFailed, health,
+    )
+    from apex_tpu.serving.faults import SITE_CONTRACTS
+
+    assert set(SITE_CONTRACTS) == set(SITES)
+    for site, (err_name, sweep) in SITE_CONTRACTS.items():
+        if err_name is None:
+            continue  # policy-only fault (routing fallback)
+        cls = getattr(health, err_name, None) or (
+            InjectedFault if err_name == "InjectedFault" else None)
+        assert cls is not None, f"{site}: unknown error {err_name}"
+        assert issubclass(cls, (ServingError, InjectedFault))
+        if sweep is not None:
+            assert sweep.startswith("APEX_CHAOS_")
+
+    # payload contract: ServingError subclasses ship diagnostics a
+    # flight recorder can attach to
+    base = ServingError("boom")
+    assert base.payload == {}
+    nf = NonFiniteLogits("nan logits in slot 3")
+    assert isinstance(nf, ServingError) and nf.payload == {}
+    ru = ReplicaUnavailable("decode down", replica="decode_1")
+    assert ru.replica == "decode_1" and ru.payload["replica"] == "decode_1"
+    sf = SpillFailed("dropped", key="ab12")
+    assert sf.key == "ab12" and sf.payload["key"] == "ab12"
+    pf = PromoteFailed("stale header", key="cd34", pages=2)
+    assert pf.pages == 2 and pf.payload == {"key": "cd34", "pages": 2}
+
+    # InjectedFault is the injector's typed carrier, not a ServingError:
+    # the scheduler's retry ladder catches it by ITS type
+    inj = InjectedFault("prefill_exec", 4)
+    assert inj.site == "prefill_exec" and inj.index == 4
+    assert not isinstance(inj, ServingError)
