@@ -117,6 +117,24 @@ CODES = {
               "consumption, jax.random.split trees, or a key "
               "consumed by more than one call instead of fold_in("
               "seed, counter) chains",
+    "APX901": "collective schedule is not scale-invariant: the APX511 "
+              "rank simulator fails at a swept mesh shape, or the "
+              "normalized schedule structure differs between swept "
+              "shapes (a schedule must be a function of axis names, "
+              "not axis sizes)",
+    "APX902": "collective volume off the declared scaling law: a "
+              "swept shape's bytes miss its pinned <entry>@<tag> "
+              "budgets.json row, deviate from the least-squares fit "
+              "of the entry's declared model, or an unmodeled "
+              "collective scales super-linearly along a mesh axis",
+    "APX903": "per-device memory grows with the mesh: optimizer-state "
+              "or peak-live bytes increase along the data axis, or "
+              "the APX703 replication taint walk fails at a swept "
+              "shape",
+    "APX904": "rule table unsafe under the sweep: APX701 coverage "
+              "fails for a scaling-registered table, or a sharded "
+              "dim does not divide its mesh-axis size product at a "
+              "swept shape",
 }
 
 

@@ -10,9 +10,14 @@ collective-volume budgets vs ``budgets.json`` — combine with
 ``--report`` to dump the per-entry table as JSON on stdout with
 findings on stderr, or ``--write-budgets`` to regenerate the manifest,
 ``--write-budgets --prune`` to also drop manifest entries whose
-registry entry no longer exists); ``--sharding`` runs the APX7xx
-sharding tier (partition-rule tables plus the rule-staged shard_map
-programs) over the ``apex_tpu.lint.sharded`` entry registry;
+registry entry no longer exists — both also sweep the scaling grid so
+the per-mesh ``<entry>@<tag>`` rows regenerate alongside the base
+rows); ``--sharding`` runs the APX7xx sharding tier (partition-rule
+tables plus the rule-staged shard_map programs) over the
+``apex_tpu.lint.sharded`` entry registry; ``--scaling`` runs the
+APX9xx scale-invariance tier (registered programs re-staged across the
+swept mesh grid: schedule isomorphism, volume scaling laws, memory
+monotonicity, rule-table divisibility);
 ``--select`` narrows the *output* to a comma-separated code list;
 ``--codes APX511,APX70*`` instead names the checks to *run* — globs
 expand against the catalogue and the owning tiers are enabled
@@ -48,6 +53,14 @@ def main(argv=None) -> int:
                     help="also run the APX7xx sharding tier: "
                          "partition-rule table coverage/consistency "
                          "and rule-staged shard_map verification")
+    ap.add_argument("--scaling", action="store_true",
+                    help="also run the APX9xx scale-invariance tier: "
+                         "registered programs re-staged across the "
+                         "swept mesh grid (schedule isomorphism, "
+                         "collective-volume scaling laws vs the "
+                         "per-mesh budgets.json rows, per-device "
+                         "memory monotonicity, rule-table "
+                         "divisibility)")
     ap.add_argument("--determinism", action="store_true",
                     help="also run the APX8xx determinism tier: "
                          "tick-path ordering/RNG/clock discipline, "
@@ -75,9 +88,10 @@ def main(argv=None) -> int:
                          "against the catalogue (e.g. APX511,APX70*); "
                          "the tiers owning the matched codes (--trace "
                          "for APX5xx, --cost for APX6xx, --sharding "
-                         "for APX7xx, --determinism for APX8xx) are "
-                         "enabled automatically and only the matched "
-                         "codes are reported")
+                         "for APX7xx, --determinism for APX8xx, "
+                         "--scaling for APX9xx) are enabled "
+                         "automatically and only the matched codes "
+                         "are reported")
     ap.add_argument("--include-fixtures", action="store_true",
                     help="also lint files marked '# apxlint: fixture'")
     ap.add_argument("--list-codes", action="store_true",
@@ -95,6 +109,7 @@ def main(argv=None) -> int:
         return 2
 
     if args.write_budgets:
+        from apex_tpu.lint.scaling import registry as scaling_registry
         from apex_tpu.lint.traced import budgets, registry
 
         registry.ensure_cpu_devices()
@@ -102,6 +117,12 @@ def main(argv=None) -> int:
         findings = registry.run_entries(registry.repo_entries(),
                                         run_checks=False,
                                         cost_out=reports)
+        # the scaling sweep's per-shape reports pin the <entry>@<tag>
+        # rows alongside the base entries
+        sweep_reports, sweep_findings = \
+            scaling_registry.sweep_cost_reports()
+        reports.extend(sweep_reports)
+        findings.extend(sweep_findings)
         for f in findings:
             print(f.render(), file=sys.stderr)
         if findings:  # refuse to pin budgets from a broken trace
@@ -147,19 +168,33 @@ def main(argv=None) -> int:
             args.sharding = True
         if any(c.startswith("APX8") for c in chosen):
             args.determinism = True
+        if any(c.startswith("APX9") for c in chosen):
+            args.scaling = True
         select = chosen if select is None else (select & chosen)
 
     paths = args.paths or ["apex_tpu"]
     reports: list = []
+    sweep_timings: list = []
     findings, n_files = lint_paths(paths,
                                    include_fixtures=args.include_fixtures,
                                    trace=not args.no_trace,
                                    trace_registry=args.trace,
                                    cost_registry=args.cost,
                                    sharding_registry=args.sharding,
+                                   scaling_registry=args.scaling,
                                    determinism=args.determinism,
                                    cost_report_out=reports,
+                                   scaling_timings_out=sweep_timings,
                                    select=select)
+    if sweep_timings:
+        # per-shape staging cost, so the run_tests.sh wall budget is
+        # attributable when the sweep grid or an entry grows
+        total = sum(t for _, t in sweep_timings)
+        shapes = ", ".join(f"{name} {t:.1f}s"
+                           for name, t in sweep_timings)
+        print(f"apxlint: scaling sweep {total:.1f}s over "
+              f"{len(sweep_timings)} shape(s): {shapes}",
+              file=sys.stderr)
     # in --report mode stdout carries ONLY the JSON table (CI pipes it
     # to an artifact file); findings move to stderr
     report_mode = args.report and args.cost

@@ -37,7 +37,13 @@ Checks come in two shapes:
   is a project check like ``hygiene``: a pure-AST pass over the
   serving-scope files (any ``serving/`` directory in the linted set)
   checking tick-path ordering, fault-contract coverage, taxonomy
-  closure, observe coherence, and RNG key discipline (APX801-805).
+  closure, observe coherence, and RNG key discipline (APX801-805);
+- the scaling tier (``scaling_registry=True`` / CLI ``--scaling``)
+  re-stages the ``apex_tpu.lint.scaling`` sweep entries across a
+  parametrized mesh grid: collective-schedule isomorphism, volume
+  scaling laws against per-mesh budget rows, per-device memory
+  monotonicity, and rule-table divisibility (APX901-904, same line-1
+  attribution).
 """
 
 import ast
@@ -137,8 +143,10 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
                trace: bool = True, trace_registry: bool = False,
                cost_registry: bool = False,
                sharding_registry: bool = False,
+               scaling_registry: bool = False,
                determinism: bool = False,
                cost_report_out: Optional[list] = None,
+               scaling_timings_out: Optional[list] = None,
                select: Optional[Iterable[str]] = None
                ) -> Tuple[List[Finding], int]:
     """Run all checks over ``paths``; returns (findings, files_checked)."""
@@ -173,7 +181,8 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
         # pure-AST like hygiene/meta — no jax import, no execution
         from apex_tpu.lint import determinism as det
         findings.extend(det.check_files(trees))
-    if trace or trace_registry or cost_registry or sharding_registry:
+    if (trace or trace_registry or cost_registry or sharding_registry
+            or scaling_registry):
         # must precede first backend touch: the sharded entries (vmem's
         # bottleneck config, the trace tier's mesh entries) need the
         # 8-device CPU world
@@ -197,6 +206,11 @@ def lint_paths(paths: Sequence[str], *, include_fixtures: bool = False,
         from apex_tpu.lint import sharded
 
         findings.extend(sharded.run_entries(sharded.repo_entries()))
+    if scaling_registry:
+        from apex_tpu.lint import scaling
+
+        findings.extend(scaling.run_entries(
+            scaling.repo_entries(), timings_out=scaling_timings_out))
 
     findings = _apply_suppressions(findings, sources)
     if select is not None:

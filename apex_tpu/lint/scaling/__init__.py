@@ -1,0 +1,27 @@
+"""APX9xx — scale-invariance lint tier.
+
+Every other traced tier verifies its contract at exactly one mesh
+shape. This tier re-stages registered programs across a swept mesh grid
+(:mod:`grid`) and verifies the properties that make a distributed
+program *scale-invariant*:
+
+- APX901 (:mod:`isomorphism`) — the collective schedule is the same
+  program at every swept shape;
+- APX902 (:mod:`volume`)      — per-collective bytes follow the
+  entry's declared scaling law, pinned per shape in budgets.json;
+- APX903 (:mod:`memory`)      — per-device state and peak-live bytes
+  never grow with the data axis; APX703 re-run per shape;
+- APX904 (:mod:`tables_check`) — rule tables cover their trees and
+  divide evenly at every swept shape.
+
+Entry points: :func:`registry.check_repo` (the lint driver),
+:func:`registry.sweep_cost_reports` (the ``--write-budgets`` input).
+"""
+
+from apex_tpu.lint.scaling.grid import (  # noqa: F401
+    FULL_GRID, HALO_GRID, ZERO_GRID, MeshShape, parse_tag,
+)
+from apex_tpu.lint.scaling.registry import (  # noqa: F401
+    ScalingEntry, StagedShape, check_repo, repo_entries, run_entries,
+    stage_entry, sweep_cost_reports,
+)

@@ -1,0 +1,81 @@
+"""Swept mesh shapes for the APX9xx scale-invariance tier.
+
+A :class:`MeshShape` is one point of the sweep: ``(dp, tp, cp)`` sizes
+for the ``data`` / ``model`` / ``context`` axes (``pipe`` stays 1 — the
+pipeline schedules carry their own per-stage entries in the trace
+tier). Each shape renders to a stable *tag* (``dp4xtp2``,
+``dp1xtp1xcp2``) used to key the per-mesh budget rows in
+``budgets.json`` (``<entry>@<tag>``) and to label findings.
+
+The default grids fit the 8-virtual-device CPU world the dryrun phases
+use (``ensure_cpu_devices``): the ZeRO train-step grid covers
+dp ∈ {2, 4, 8} × tp = 1, dp ∈ {2, 4} × tp = 2, and dp = 2 × tp = 4;
+dp8 × tp2 (16 devices) is the one point of the full dp∈{2,4,8} ×
+tp∈{1,2} product that cannot be staged on 8 devices — it joins the
+grid automatically on a larger world only if a future PR raises the
+device count AND regenerates budgets.json. The halo grid sweeps the
+``context`` ring at cp ∈ {2, 4}. The union is 8 distinct shapes.
+"""
+
+from typing import NamedTuple, Tuple
+
+
+class MeshShape(NamedTuple):
+    """One swept mesh point: axis sizes for data/model/context."""
+    dp: int = 1
+    tp: int = 1
+    cp: int = 1
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.tp * self.cp
+
+    @property
+    def tag(self) -> str:
+        t = f"dp{self.dp}xtp{self.tp}"
+        if self.cp > 1:
+            t += f"xcp{self.cp}"
+        return t
+
+    def axis_sizes(self) -> dict:
+        """Mesh-axis name -> size at this shape (pipe always 1)."""
+        from apex_tpu.transformer import parallel_state as ps
+
+        return {ps.DATA_AXIS: self.dp, ps.PIPE_AXIS: 1,
+                ps.CONTEXT_AXIS: self.cp, ps.TENSOR_AXIS: self.tp}
+
+
+#: dp x tp sweep for the ZeRO train step (6 shapes, all <= 8 devices).
+ZERO_GRID: Tuple[MeshShape, ...] = (
+    MeshShape(dp=2, tp=1),
+    MeshShape(dp=4, tp=1),
+    MeshShape(dp=8, tp=1),
+    MeshShape(dp=2, tp=2),
+    MeshShape(dp=4, tp=2),
+    MeshShape(dp=2, tp=4),
+)
+
+#: context-ring sweep for the spatial bottleneck halo exchange.
+HALO_GRID: Tuple[MeshShape, ...] = (
+    MeshShape(dp=1, tp=1, cp=2),
+    MeshShape(dp=1, tp=1, cp=4),
+)
+
+#: every distinct shape any entry sweeps — the grid the rule-table
+#: scale-safety audit (APX904) runs its divisibility pass over.
+FULL_GRID: Tuple[MeshShape, ...] = ZERO_GRID + HALO_GRID
+
+
+def parse_tag(tag: str) -> MeshShape:
+    """Inverse of :attr:`MeshShape.tag` (raises ValueError on junk)."""
+    import re
+
+    m = re.fullmatch(r"dp(\d+)xtp(\d+)(?:xcp(\d+))?", tag)
+    if not m:
+        raise ValueError(f"not a mesh-shape tag: {tag!r}")
+    return MeshShape(dp=int(m.group(1)), tp=int(m.group(2)),
+                     cp=int(m.group(3) or 1))
+
+
+__all__ = ["MeshShape", "ZERO_GRID", "HALO_GRID", "FULL_GRID",
+           "parse_tag"]

@@ -1,0 +1,175 @@
+"""APX901 — collective-schedule isomorphism across swept mesh shapes.
+
+APX511 proves all ranks of ONE mesh agree on the collective schedule;
+this check proves the schedule is the *same program* at every swept
+mesh size. Two obligations per entry:
+
+1. **Per-shape agreement** — the APX511 simulator is re-issued at every
+   swept shape (pairwise rank equality modulo axis index, ppermute
+   well-formedness). A schedule that happens to agree at dp2 but
+   branches on ``axis_index < 2`` diverges the moment dp grows; it
+   fires here at the swept shape, re-coded APX901 with the shape tag.
+2. **Cross-shape structural equality** — the rank-0 footprint of every
+   ``shard_map`` body is normalized to its *structure*: collective
+   items keep ``(primitive, axes)`` and drop byte counts; loop nesting
+   is kept with scan lengths erased (trip counts may legally track a
+   hyperparameter); a ``ppermute`` permutation is classified as a ring
+   ``shift(delta)`` when it is a full single-step rotation of its axis,
+   else kept verbatim. Structures must be identical across every swept
+   shape — a hardcoded axis size shows up as an extra/missing
+   collective, a diverging explicit permutation, or a shift whose
+   delta moves with the mesh.
+
+The normalization deliberately keeps a hardcoded permutation visible:
+``[(0, 1), (1, 0)]`` classifies as ``shift(1)`` on a 2-ring but stays
+an explicit pair list on a 4-ring, so sweeping cp flags it. A 2-ring
+shift matches either rotation direction (delta +1 and -1 coincide at
+size 2), so a reverse ring swept from cp2 to cp4 stays clean.
+"""
+
+import itertools
+from typing import List, Tuple
+
+from apex_tpu.lint import Finding
+from apex_tpu.lint.traced import jaxprlib as jl
+from apex_tpu.lint.traced import schedule
+
+
+def _classify_perm(perm: tuple, axis_size: int):
+    """A full single-step-uniform rotation -> ('shift', delta, n);
+    anything else stays ('perm', perm)."""
+    if axis_size > 1 and len(perm) == axis_size:
+        srcs = {p[0] for p in perm}
+        if srcs == set(range(axis_size)):
+            deltas = {(dst - src) % axis_size for src, dst in perm}
+            if len(deltas) == 1:
+                return ("shift", deltas.pop(), axis_size)
+    return ("perm", tuple(tuple(p) for p in perm))
+
+
+def _shift_equal(a, b) -> bool:
+    """Two shift classifications are isomorphic when their deltas are
+    congruent as signed single steps; on a 2-ring both directions
+    coincide, so a size-2 shift matches any shift."""
+    _, da, na = a
+    _, db, nb = b
+    if na == 2 or nb == 2:
+        return True
+    sa = da if da <= na // 2 else da - na
+    sb = db if db <= nb // 2 else db - nb
+    return sa == sb
+
+
+def _structural(fp, axis_sizes) -> Tuple:
+    out = []
+    for item in fp:
+        if item[0] == "coll":
+            prim, axes, extra = item[1], item[2], item[3]
+            if prim == "ppermute" and extra:
+                n = 1
+                for ax in axes:
+                    n *= int(axis_sizes.get(ax, 1))
+                out.append(("coll", prim, axes,
+                            _classify_perm(extra[0], n)))
+            else:
+                out.append(("coll", prim, axes))
+        elif item[0] == "scan":
+            out.append(("scan", _structural(item[2], axis_sizes)))
+        elif item[0] == "while":
+            out.append(("while", _structural(item[1], axis_sizes),
+                        _structural(item[2], axis_sizes)))
+    return tuple(out)
+
+
+def _iso_equal(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x[0] != y[0]:
+            return False
+        if x[0] == "coll":
+            if x[1] != y[1] or x[2] != y[2]:
+                return False
+            xp = x[3] if len(x) > 3 else None
+            yp = y[3] if len(y) > 3 else None
+            if (xp is None) != (yp is None):
+                return False
+            if xp is not None:
+                if xp[0] == "shift" and yp[0] == "shift":
+                    if not _shift_equal(xp, yp):
+                        return False
+                elif xp != yp:
+                    return False
+        elif x[0] == "scan":
+            if not _iso_equal(x[1], y[1]):
+                return False
+        elif x[0] == "while":
+            if not (_iso_equal(x[1], y[1]) and _iso_equal(x[2], y[2])):
+                return False
+    return True
+
+
+def _first_diff(a, b) -> str:
+    for i, (x, y) in enumerate(itertools.zip_longest(a, b)):
+        if x is None or y is None or not _iso_equal((x,), (y,)):
+            return f"step {i}: {x!r} vs {y!r}"
+    return f"lengths {len(a)} vs {len(b)}"
+
+
+def shape_structures(closed) -> List[Tuple]:
+    """Normalized rank-0 structural footprint per shard_map equation,
+    in program order."""
+    structures: List[Tuple] = []
+    for eqn in jl.all_eqns(closed, into_pallas=False):
+        if eqn.primitive.name != "shard_map":
+            continue
+        try:
+            axis_sizes = dict(eqn.params["mesh"].shape)
+        except Exception:  # noqa: BLE001
+            axis_sizes = {}
+        rank0 = {ax: 0 for ax in axis_sizes}
+        fp = schedule._footprint(eqn.params["jaxpr"], {}, rank0)
+        structures.append(_structural(fp, axis_sizes))
+    return structures
+
+
+def check(staged, path: str, entry) -> List[Finding]:
+    findings: List[Finding] = []
+    baseline = None
+    base_tag = None
+    for s in staged:
+        tag = s.shape.tag
+        # (1) APX511 re-issued at this shape, re-coded with the tag
+        for f in schedule.check(s.closed, path, entry.name):
+            findings.append(Finding(
+                "APX901", path, 1, f"[{tag}] {f.message}"))
+        # (2) structural comparison against the first staged shape
+        try:
+            structures = shape_structures(s.closed)
+        except schedule._ScheduleError as e:
+            findings.append(Finding(
+                "APX901", path, 1,
+                f"[{tag}] entry '{entry.name}': {e}"))
+            continue
+        if baseline is None:
+            baseline, base_tag = structures, tag
+            continue
+        if len(structures) != len(baseline):
+            findings.append(Finding(
+                "APX901", path, 1,
+                f"entry '{entry.name}': {len(structures)} shard_map "
+                f"program(s) at {tag} vs {len(baseline)} at {base_tag} "
+                f"— the staged program's structure depends on the mesh "
+                f"size"))
+            continue
+        for i, (got, want) in enumerate(zip(structures, baseline)):
+            if not _iso_equal(got, want):
+                findings.append(Finding(
+                    "APX901", path, 1,
+                    f"entry '{entry.name}': collective schedule of "
+                    f"shard_map {i} is not scale-invariant — "
+                    f"{_first_diff(want, got)} between {base_tag} and "
+                    f"{tag} (a schedule must be a function of axis "
+                    f"names, not axis sizes)"))
+                break
+    return findings

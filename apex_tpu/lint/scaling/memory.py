@@ -1,0 +1,84 @@
+"""APX903 — per-device memory must not grow with the mesh.
+
+The point of sharding is that adding devices shrinks (or at worst
+holds) every device's footprint. Three obligations per swept entry,
+all evaluated along the ``dp`` axis within each (tp, cp) family:
+
+1. **Optimizer-state bytes** — the entry's declared per-device state
+   accounting (e.g. ``DistributedFusedAdam.state_bytes_per_device``)
+   must be non-increasing in dp. A ZeRO shard that stops scaling —
+   a spec flipped back to replicated, a buffer sized off the global
+   rather than the local batch — shows up as a flat or rising curve.
+2. **Per-device peak-live** — the APX5xx liveness walk
+   (:func:`apex_tpu.lint.traced.cost._peak_live`) re-run on every
+   ``shard_map`` body at every swept shape; the maximum body peak must
+   be non-increasing in dp. This is the device-local number (the
+   body sees local shapes), unlike APX604's whole-program estimate.
+3. **Replication taint** — the APX703 walk (rule-derived in_specs
+   survive into the traced ``shard_map``; no large replicated
+   dot_general operand) re-issued at every swept shape, re-coded
+   APX903 with the shape tag. A spec that degenerates only at tp=4
+   fires here, not on a pod.
+"""
+
+from typing import Dict, List, Tuple
+
+from apex_tpu.lint import Finding
+
+
+def body_peak_live(closed) -> int:
+    """Max peak-live over every shard_map body of the staged program —
+    the per-device high-water estimate at this shape."""
+    from apex_tpu.lint.traced import cost
+    from apex_tpu.lint.traced import jaxprlib as jl
+
+    peak = 0
+    for eqn in jl.all_eqns(closed, into_pallas=False):
+        if eqn.primitive.name == "shard_map":
+            peak = max(peak, cost._peak_live(eqn.params["jaxpr"]))
+    return peak
+
+
+def _dp_families(staged) -> Dict[Tuple[int, int], list]:
+    """(tp, cp) -> staged shapes sorted by dp (only families with at
+    least two dp points can express a monotonicity claim)."""
+    fams: Dict[Tuple[int, int], list] = {}
+    for s in staged:
+        fams.setdefault((s.shape.tp, s.shape.cp), []).append(s)
+    return {k: sorted(v, key=lambda s: s.shape.dp)
+            for k, v in fams.items() if len(v) > 1}
+
+
+def _monotone(series, path: str, entry, what: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for (prev_shape, prev), (cur_shape, cur) in zip(series, series[1:]):
+        if cur > prev:
+            findings.append(Finding(
+                "APX903", path, 1,
+                f"entry '{entry.name}': per-device {what} grows with "
+                f"the data axis — {prev} B at {prev_shape.tag} but "
+                f"{cur} B at {cur_shape.tag}; adding data-parallel "
+                f"devices must never cost a device memory"))
+    return findings
+
+
+def check(staged, path: str, entry) -> List[Finding]:
+    from apex_tpu.lint.sharded import propagation
+
+    findings: List[Finding] = []
+    for fam in _dp_families(staged).values():
+        if entry.state_bytes is not None:
+            findings.extend(_monotone(
+                [(s.shape, int(entry.state_bytes(s.shape)))
+                 for s in fam],
+                path, entry, "optimizer-state bytes"))
+        findings.extend(_monotone(
+            [(s.shape, body_peak_live(s.closed)) for s in fam],
+            path, entry, "peak-live estimate"))
+    for s in staged:
+        if s.in_specs is None:
+            continue
+        for f in propagation.check(s.closed, s.in_specs, path, entry):
+            findings.append(Finding(
+                "APX903", path, 1, f"[{s.shape.tag}] {f.message}"))
+    return findings

@@ -1,0 +1,98 @@
+"""APX904 — partition-rule tables must be safe at every swept shape.
+
+The sharded tier (APX701) proves a rule table covers its trees with no
+dead or ambiguous rules — a shape-independent property. This check adds
+the shape-dependent half, across the full sweep grid:
+
+1. **Coverage under the sweep** — the APX701 coverage/dead-rule
+   analysis is re-issued (through the same :mod:`rules_check`
+   implementation, so the two tiers cannot drift) and re-coded APX904:
+   a table registered for scaling must hold its own contract before
+   divisibility even makes sense.
+2. **Divisibility audit** — for every matched leaf, every sharded dim
+   must divide evenly by the product of its mesh-axis sizes at every
+   swept shape. ``dim % axis_size != 0`` is exactly the crash an
+   8-chip pod produces from a table that looked fine at tp=2: a head
+   count of 2 sharded over ``model`` works at tp<=2 and throws at
+   tp=4. The finding names the leaf, the dim, the axes, and every
+   failing shape tag, so the fix (pad the dim, gate the shape, or
+   re-spec the rule) is mechanical.
+"""
+
+from typing import List
+
+from apex_tpu.lint import Finding
+
+
+class _Apx701Shim:
+    """The slice of a sharded-tier entry that rules_check's APX701 half
+    reads; the APX702 derived-tree attributes are disabled so only the
+    coverage analysis runs under the sweep."""
+
+    def __init__(self, entry):
+        self.name = entry.name
+        self.rules = entry.rules
+        self.trees = entry.trees
+        self.optimizer_families = ()
+        self.reference_specs = None
+        self.kv_cache_tree = None
+        self.qkv_kernel_re = ""
+
+
+def _spec_dim_axes(spec) -> List[tuple]:
+    """(dim, (axis, ...)) per sharded dim of a PartitionSpec."""
+    out = []
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        out.append((dim, tuple(entry) if isinstance(entry, tuple)
+                    else (entry,)))
+    return out
+
+
+def divisibility_findings(entry, path: str) -> List[Finding]:
+    from apex_tpu.partition import rule_match_table
+
+    rules = tuple(entry.rules())
+    trees = entry.trees() if entry.trees is not None else {}
+    findings: List[Finding] = []
+    for tree_name, tree in sorted(trees.items()):
+        for leaf_path, leaf, hits in rule_match_table(rules, tree):
+            if len(hits) != 1:
+                continue  # uncovered/ambiguous: APX904 coverage finding
+            spec = rules[hits[0]][1]
+            shape = tuple(getattr(leaf, "shape", ()))
+            for dim, axes in _spec_dim_axes(spec):
+                if dim >= len(shape):
+                    continue  # rank mismatch: APX904 coverage finding
+                bad = []
+                for mesh in entry.grid:
+                    sizes = mesh.axis_sizes()
+                    prod = 1
+                    for ax in axes:
+                        prod *= int(sizes.get(ax, 1))
+                    if prod > 1 and shape[dim] % prod != 0:
+                        bad.append((mesh.tag, prod))
+                if bad:
+                    tags = ", ".join(
+                        f"{t} ({p} ways)" for t, p in bad)
+                    findings.append(Finding(
+                        "APX904", path, 1,
+                        f"entry '{entry.name}': '{tree_name}' leaf "
+                        f"'{leaf_path}' dim {dim} (size {shape[dim]}) "
+                        f"shards over {list(axes)} but does not divide "
+                        f"at swept shape(s) {tags} — rule "
+                        f"{rules[hits[0]][0]!r} would crash there"))
+    return findings
+
+
+def check(entry, path: str) -> List[Finding]:
+    from apex_tpu.lint.sharded import rules_check
+
+    findings = [
+        Finding("APX904", f.path, f.line, f.message)
+        for f in rules_check.check(_Apx701Shim(entry), path)
+        if f.code == "APX701"
+    ]
+    findings.extend(divisibility_findings(entry, path))
+    return findings

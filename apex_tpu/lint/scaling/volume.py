@@ -1,0 +1,205 @@
+"""APX902 — collective-volume scaling law over the swept mesh grid.
+
+The APX6xx cost interpreter prices every collective of a staged
+program; APX603 pins that number at one mesh shape. This check makes
+the *function* bytes(mesh) part of the reviewed contract:
+
+1. **Per-mesh pinned rows** — every swept shape's total collective
+   volume must equal its ``<entry>@<tag>`` row in ``budgets.json``
+   byte-exact (rows are written by ``--write-budgets``, pruned by
+   ``--write-budgets --prune``). A missing, stale, or drifted row is a
+   finding: a PR that changes the communication schedule at ANY swept
+   shape must regenerate the manifest so the delta is reviewable.
+2. **Declared scaling model** — each entry declares, per collective
+   primitive, a basis of shape functions (e.g. the ZeRO law
+   ``all_gather: flat_params(tp)``, ``reduce_scatter:
+   flat_params(tp) * dp``). The measured bytes are least-squares
+   fitted against the basis over the whole grid and must be
+   reproduced exactly (0.5% / 64-byte slack for float fitting) at
+   every shape — a hardcoded size or a rank-count branch bends the
+   curve away from the declared law at some swept point.
+3. **Super-linear drift guard** — a measured collective the model does
+   not cover must still scale at most linearly along every swept axis:
+   between two shapes differing in exactly one axis, the byte ratio
+   may not exceed the axis-size ratio. Catches the classic
+   quadratic-in-ranks regression (all-to-all emulated with per-pair
+   sends) without requiring a model for every incidental collective.
+"""
+
+from typing import Dict, List, Tuple
+
+from apex_tpu.lint import Finding
+
+_FIT_RTOL = 0.005
+_FIT_ATOL = 64
+_DRIFT_TOL = 0.01
+
+
+def _solve(ata: List[List[float]], atb: List[float]) -> List[float]:
+    """Gaussian elimination with partial pivoting; singular columns get
+    coefficient 0 (an over-parameterized basis is not an error)."""
+    n = len(atb)
+    a = [row[:] + [atb[i]] for i, row in enumerate(ata)]
+    for col in range(n):
+        piv = max(range(col, n), key=lambda r: abs(a[r][col]))
+        if abs(a[piv][col]) < 1e-9:
+            continue
+        a[col], a[piv] = a[piv], a[col]
+        for r in range(n):
+            if r == col:
+                continue
+            f = a[r][col] / a[col][col]
+            for c in range(col, n + 1):
+                a[r][c] -= f * a[col][c]
+    out = []
+    for i in range(n):
+        out.append(a[i][n] / a[i][i] if abs(a[i][i]) > 1e-9 else 0.0)
+    return out
+
+
+def fit(basis: Tuple[Tuple[str, object], ...], shapes,
+        measured: List[float]) -> Tuple[List[float], List[float]]:
+    """Least-squares coefficients for ``measured ~= sum c_j * f_j`` and
+    the per-shape predictions."""
+    design = [[float(fn(s)) for _, fn in basis] for s in shapes]
+    k = len(basis)
+    ata = [[sum(design[i][p] * design[i][q] for i in range(len(shapes)))
+            for q in range(k)] for p in range(k)]
+    atb = [sum(design[i][p] * measured[i] for i in range(len(shapes)))
+           for p in range(k)]
+    coeffs = _solve(ata, atb)
+    preds = [sum(c * design[i][j] for j, c in enumerate(coeffs))
+             for i in range(len(shapes))]
+    return coeffs, preds
+
+
+def _model_findings(staged, path: str, entry) -> List[Finding]:
+    findings: List[Finding] = []
+    model = entry.volume_model() if entry.volume_model else {}
+    shapes = [s.shape for s in staged]
+    per_coll: Dict[str, List[float]] = {}
+    for s in staged:
+        for prim in s.report.per_collective:
+            per_coll.setdefault(prim, [])
+    for prim in per_coll:
+        per_coll[prim] = [float(s.report.per_collective.get(prim, 0))
+                          for s in staged]
+
+    for prim, measured in sorted(per_coll.items()):
+        basis = model.get(prim)
+        if basis is not None:
+            coeffs, preds = fit(basis, shapes, measured)
+            for s, m, p in zip(shapes, measured, preds):
+                if abs(m - p) > max(_FIT_RTOL * m, _FIT_ATOL):
+                    terms = ", ".join(
+                        f"{c:.1f}*{name}"
+                        for (name, _), c in zip(basis, coeffs))
+                    findings.append(Finding(
+                        "APX902", path, 1,
+                        f"entry '{entry.name}': {prim} volume at "
+                        f"{s.tag} is {int(m)} B but the declared "
+                        f"scaling model fits {int(p)} B ({terms}) — "
+                        f"the measured bytes(mesh) curve does not "
+                        f"follow the declared law"))
+            continue
+        # no declared law: super-linear drift guard along single axes
+        for i, si in enumerate(shapes):
+            for j, sj in enumerate(shapes):
+                diffs = [(a, getattr(si, a), getattr(sj, a))
+                         for a in ("dp", "tp", "cp")
+                         if getattr(si, a) != getattr(sj, a)]
+                if len(diffs) != 1:
+                    continue
+                axis, vi, vj = diffs[0]
+                if vj <= vi or measured[i] <= 0:
+                    continue
+                ratio = measured[j] / measured[i]
+                if ratio > (vj / vi) * (1 + _DRIFT_TOL):
+                    findings.append(Finding(
+                        "APX902", path, 1,
+                        f"entry '{entry.name}': {prim} volume grows "
+                        f"super-linearly in {axis} — "
+                        f"{int(measured[i])} B at {si.tag} vs "
+                        f"{int(measured[j])} B at {sj.tag} "
+                        f"(x{ratio:.2f} for a x{vj // vi} axis); "
+                        f"declare a scaling model for it or fix the "
+                        f"schedule"))
+    for prim in sorted(set(model) - set(per_coll)):
+        findings.append(Finding(
+            "APX902", path, 1,
+            f"entry '{entry.name}': declared scaling model covers "
+            f"'{prim}' but no swept shape issues it — stale model"))
+    return findings
+
+
+def check(staged, path: str, entry, manifest) -> List[Finding]:
+    from apex_tpu.lint.traced import budgets
+
+    findings: List[Finding] = []
+    base = entry.budget_name or entry.name
+    # a missing or malformed manifest is reported once per run by
+    # check_manifest_rows; here it just disables the row gate
+    if manifest is not None and not budgets.validate(manifest):
+        rows = manifest.get("entries", {})
+        for s in staged:
+            name = f"{base}@{s.shape.tag}"
+            row = rows.get(name)
+            if row is None:
+                findings.append(Finding(
+                    "APX902", path, 1,
+                    f"entry '{entry.name}': no per-mesh budget row "
+                    f"'{name}' — regenerate with "
+                    f"`python -m apex_tpu.lint --write-budgets`"))
+                continue
+            got = s.report.collective_bytes
+            if got != row["collective_bytes"]:
+                findings.append(Finding(
+                    "APX902", path, 1,
+                    f"entry '{entry.name}': collective volume {got} B "
+                    f"at {s.shape.tag} != pinned "
+                    f"{row['collective_bytes']} B ('{name}') — the "
+                    f"communication schedule changed at this mesh "
+                    f"shape; regenerate budgets.json if intentional"))
+    findings.extend(_model_findings(staged, path, entry))
+    return findings
+
+
+def check_manifest_rows(swept: Dict[str, set], manifest
+                        ) -> List[Finding]:
+    """Manifest-level findings, emitted once per run: a missing or
+    malformed budgets.json, and stale ``@``-rows — every per-mesh row
+    must belong to a registered sweep entry and a currently swept
+    shape."""
+    from apex_tpu.lint.traced import budgets
+
+    findings: List[Finding] = []
+    if manifest is None:
+        if swept:
+            findings.append(Finding(
+                "APX902", budgets.manifest_path(), 1,
+                "budgets.json does not exist — seed it (and the "
+                "per-mesh @-rows) with "
+                "`python -m apex_tpu.lint --write-budgets`"))
+        return findings
+    errs = budgets.validate(manifest)
+    if errs:
+        findings.append(Finding(
+            "APX902", budgets.manifest_path(), 1,
+            "budgets.json fails schema validation: " + "; ".join(errs)))
+        return findings
+    if not swept:
+        # no volume sweep ran (e.g. a --codes-narrowed run over table
+        # entries only) — nothing to compare the @-rows against
+        return findings
+    rows = (manifest or {}).get("entries", {})
+    for name in sorted(rows):
+        if "@" not in name:
+            continue
+        b, _, tag = name.partition("@")
+        if tag not in swept.get(b, ()):
+            findings.append(Finding(
+                "APX902", budgets.manifest_path(), 1,
+                f"budgets.json per-mesh row '{name}' matches no "
+                f"registered sweep shape — regenerate with "
+                f"`python -m apex_tpu.lint --write-budgets --prune`"))
+    return findings

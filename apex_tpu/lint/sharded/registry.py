@@ -38,6 +38,7 @@ from apex_tpu.lint.traced.registry import (
     _snapshot_parallel_state,
     ensure_cpu_devices,
     zero_dp2xtp2_parts,
+    zero_parts,
 )
 
 _REPLICATION_FLOOR = 1 << 20
@@ -287,6 +288,18 @@ def repo_entries() -> List[ShardedEntry]:
             build=zero_dp2xtp2_parts,
             mesh=_mesh(tp=2, n_devices=4), min_devices=4,
             budget_name="gpt_tiny_dp2xtp2_zero"),
+        # the ROADMAP item-5 headline shape: the same rule-derived
+        # builder at dp4 x tp2 on the full 8-device world, so APX703/704
+        # verify in_names and the per-rank schedule at the shape the
+        # training headline will actually run (the APX9xx scaling tier
+        # additionally sweeps the whole grid)
+        ShardedEntry(
+            "gpt_tiny_dp4xtp2_zero",
+            "apex_tpu.contrib.optimizers.distributed_fused_adam",
+            rules=gpt_rules,
+            build=lambda: zero_parts(dp=4, tp=2),
+            mesh=_mesh(tp=2, n_devices=8), min_devices=8,
+            budget_name="gpt_tiny_dp4xtp2_zero"),
     ]
 
 

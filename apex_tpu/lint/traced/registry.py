@@ -516,21 +516,36 @@ def _tp_block_entry(tp):
     return build
 
 
+def bottleneck_parts():
+    """The spatial-parallel bottleneck halo exchange: conv stack whose
+    width dim shards over ``context``, ring-ppermute halos at the shard
+    edges. Returns ``(fn, args, in_specs)`` so the APX9xx scaling tier
+    can re-stage it across swept ``cp`` sizes (the width of 16 divides
+    every swept context size); the caller's mesh sets the ``context``
+    axis size."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.contrib.bottleneck import spatial_parallel_bottleneck
+    from apex_tpu.transformer import parallel_state as ps
+
+    params = {"w1": _sds((1, 1, 8, 4), "float32"),
+              "w2": _sds((3, 3, 4, 4), "float32"),
+              "w3": _sds((1, 1, 4, 8), "float32")}
+    # one spec per flattened operand (not a pytree-prefix P()) so the
+    # APX703/903 taint walk sees the same operand count shard_map does
+    in_specs = ({k: P() for k in sorted(params)},
+                P(None, ps.CONTEXT_AXIS))
+    fn = ps.shard_map(
+        spatial_parallel_bottleneck,
+        in_specs=in_specs,
+        out_specs=P(None, ps.CONTEXT_AXIS))
+    return fn, (params, _sds((2, 16, 5, 8), "float32")), in_specs
+
+
 def _bottleneck_entry():
     def build():
-        from jax.sharding import PartitionSpec as P
-
-        from apex_tpu.contrib.bottleneck import spatial_parallel_bottleneck
-        from apex_tpu.transformer import parallel_state as ps
-
-        params = {"w1": _sds((1, 1, 8, 4), "float32"),
-                  "w2": _sds((3, 3, 4, 4), "float32"),
-                  "w3": _sds((1, 1, 4, 8), "float32")}
-        fn = ps.shard_map(
-            spatial_parallel_bottleneck,
-            in_specs=(P(), P(None, ps.CONTEXT_AXIS)),
-            out_specs=P(None, ps.CONTEXT_AXIS))
-        return fn, (params, _sds((2, 16, 5, 8), "float32"))
+        fn, args, _ = bottleneck_parts()
+        return fn, args
 
     return build
 
@@ -1248,14 +1263,18 @@ def _local_shapes(tree, specs, axis_sizes):
                                   is_leaf=lambda x: isinstance(x, P))
 
 
-def zero_dp2xtp2_parts():
-    """The ROADMAP item-3 headline config: rule-table-sharded GPT train
-    step, dp2 x tp2, ZeRO optimizer state (bf16 m) row-sharded over
-    ``(model, data)`` jointly. Returns ``(fn, args, in_specs)`` — the
-    spec tree is consumed by the APX7xx sharded tier (APX703 checks the
-    shard_map in_names against it), the ``(fn, args)`` pair by the
-    plain trace/cost tiers. Everything sharded here derives from
-    ``partition.gpt_rules()``; nothing is hand-specified."""
+def zero_parts(dp: int = 2, tp: int = 2):
+    """The ROADMAP item-3 headline config at a parametric mesh shape:
+    rule-table-sharded GPT train step, dp x tp, ZeRO optimizer state
+    (bf16 m) row-sharded over ``(model, data)`` jointly. Returns
+    ``(fn, args, in_specs)`` — the spec tree is consumed by the APX7xx
+    sharded tier (APX703 checks the shard_map in_names against it), the
+    ``(fn, args)`` pair by the plain trace/cost tiers, and the APX9xx
+    scaling tier re-stages this builder at every swept ``(dp, tp)``
+    shape. Everything sharded here derives from
+    ``partition.gpt_rules()``; nothing is hand-specified — the caller's
+    mesh must carry ``data`` axis size ``dp`` and ``model`` axis size
+    ``tp``."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -1268,7 +1287,6 @@ def zero_dp2xtp2_parts():
     from apex_tpu.partition import gpt_rules, match_partition_rules
     from apex_tpu.transformer import parallel_state as ps
 
-    tp, dp = 2, 2
     cfg = gpt_tiny()
     model = GPTModel(cfg, tp_size=tp)
     params = jax.eval_shape(
@@ -1307,9 +1325,15 @@ def zero_dp2xtp2_parts():
     return fn, args, in_specs
 
 
-def _zero_dp2xtp2_entry():
+def zero_dp2xtp2_parts():
+    """The dp2 x tp2 anchor shape of :func:`zero_parts` (the original
+    ROADMAP item-3 headline config)."""
+    return zero_parts(dp=2, tp=2)
+
+
+def _zero_entry(dp, tp):
     def build():
-        fn, args, _ = zero_dp2xtp2_parts()
+        fn, args, _ = zero_parts(dp=dp, tp=tp)
         return fn, args
 
     return build
@@ -1393,9 +1417,19 @@ def repo_entries() -> List[TraceEntry]:
         # re-traces the same builder for its in_specs/schedule checks
         TraceEntry("gpt_tiny_dp2xtp2_zero",
                    "apex_tpu.contrib.optimizers.distributed_fused_adam",
-                   _zero_dp2xtp2_entry(),
+                   _zero_entry(2, 2),
                    checks=("precision", "memory", "schedule"),
                    mesh=_mesh(tp=2, n_devices=4), min_devices=4),
+        # ROADMAP item 5 payoff: the same rule-derived ZeRO step at the
+        # dp4 x tp2 headline shape (the full 8-device world) — the
+        # APX9xx scaling tier sweeps the builder across the whole
+        # (dp, tp) grid; this entry pins the headline shape in the
+        # APX5xx/6xx tiers too, with its own budgets.json row
+        TraceEntry("gpt_tiny_dp4xtp2_zero",
+                   "apex_tpu.contrib.optimizers.distributed_fused_adam",
+                   _zero_entry(4, 2),
+                   checks=("precision", "memory", "schedule"),
+                   mesh=_mesh(tp=2, n_devices=8), min_devices=8),
         TraceEntry("bottleneck_spatial_cp2",
                    "apex_tpu.contrib.bottleneck.bottleneck",
                    _bottleneck_entry(),

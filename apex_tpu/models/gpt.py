@@ -127,9 +127,15 @@ def draft_gpt_medium() -> GPTConfig:
     behind the ``gpt_draft_forward_step`` budget entry: its per-step HBM
     traffic (params + draft cache) must stay under 3% of the target's
     per-step parameter read, the amortization condition BASELINE r13
-    derives for model-draft break-even."""
+    derives for model-draft break-even.
+
+    ``num_heads=4`` (head_dim 32), not 2: the drafter shares the
+    target's pod slice, so its KV-cache head axis must divide every
+    tensor-parallel size the target is swept over (APX904 fires on
+    ``2 % 4`` at tp=4). Param shapes and cache bytes are unchanged —
+    qkv width is ``3 * hidden`` either way."""
     return GPTConfig(vocab_size=50304, hidden_size=128, num_layers=2,
-                     num_heads=2, ffn_hidden_size=256,
+                     num_heads=4, ffn_hidden_size=256,
                      max_position_embeddings=1024, use_rope=True)
 
 

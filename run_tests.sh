@@ -24,19 +24,22 @@
 #                           #     serving tiers (w8 / kv8 / w8+kv8) must
 #                           #     track the trained fp32 eval-NLL curve
 #                           #     — run on every PR
-#   ./run_tests.sh lint     # apxlint, all five tiers: AST contract
+#   ./run_tests.sh lint     # apxlint, all six tiers: AST contract
 #                           #     checks (kernel aliasing, collectives,
 #                           #     AMP lists, hygiene), the VMEM budget
 #                           #     pass, the jaxpr trace tier (APX5xx)
 #                           #     over the entry registry, the cost
 #                           #     tier (APX6xx byte budgets), the
 #                           #     sharding tier (APX7xx partition-rule
-#                           #     contracts), and the determinism tier
+#                           #     contracts), the determinism tier
 #                           #     (APX8xx serving-stack race/ordering +
-#                           #     fault-contract coverage) — blocking in
-#                           #     CI, with a combined wall-time budget
-#                           #     enforced so the gate stays fast enough
-#                           #     to run on every push
+#                           #     fault-contract coverage), and the
+#                           #     scaling tier (APX9xx mesh-sweep
+#                           #     scale-invariance, per-shape trace
+#                           #     time reported on stderr) — blocking
+#                           #     in CI, with a combined wall-time
+#                           #     budget enforced so the gate stays
+#                           #     fast enough to run on every push
 #
 # The suite forces the CPU backend inside conftest.py (the axon env pins
 # JAX_PLATFORMS at interpreter start, so pytest must be run through this
@@ -67,13 +70,14 @@ case "$tier" in
   gate)  exec python -m pytest tests/L1/test_loss_curve_parity.py \
              tests/L1/test_quant_eval_parity.py -q "$@" ;;
   lint)  # combined AST + VMEM + trace + cost + sharding + determinism
-         # tiers, under a wall-time budget: a slow lint gate stops
-         # being run, so exceeding the budget is itself a failure (trim
-         # the entry registry or speed it up)
+         # + scaling tiers, under a wall-time budget: a slow lint gate
+         # stops being run, so exceeding the budget is itself a failure
+         # (trim the entry registry or sweep grid — the per-shape
+         # scaling timings on stderr say where the time goes)
          budget=90
          start=$SECONDS
          python -m apex_tpu.lint apex_tpu tests --trace --cost \
-             --sharding --determinism "$@"
+             --sharding --determinism --scaling "$@"
          elapsed=$(( SECONDS - start ))
          if (( elapsed > budget )); then
            echo "apxlint: combined run took ${elapsed}s," \

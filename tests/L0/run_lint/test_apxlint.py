@@ -31,6 +31,7 @@ def test_codes_registry_complete():
         "APX601", "APX602", "APX603", "APX604",
         "APX701", "APX702", "APX703", "APX704",
         "APX801", "APX802", "APX803", "APX804", "APX805",
+        "APX901", "APX902", "APX903", "APX904",
     }
     assert all(CODES[c] for c in CODES)  # every code documented
 

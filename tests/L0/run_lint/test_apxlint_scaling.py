@@ -1,0 +1,517 @@
+"""Scaling-tier (APX9xx) tests.
+
+Same three layers as the other traced tiers:
+
+- known-bad / known-clean *sweep entry* pairs per code: every checker
+  must fire on a builder that seeds exactly its scale-variance bug and
+  stay silent on the minimally-different clean twin;
+- seeded-bug meta-tests: a hardcoded rank count survives the anchor
+  shape and fires APX901 the moment the grid sweeps past it; a ZeRO
+  state spec flipped to replicated (program seeded, contract held)
+  fires APX903 at every swept shape;
+- the repo registry itself must be populated, cover >= 6 mesh shapes,
+  and lint clean — including the byte-exact per-mesh rows pinned in
+  budgets.json.
+"""
+
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from apex_tpu.lint.scaling import (  # noqa: E402
+    FULL_GRID, MeshShape, ScalingEntry, parse_tag, run_entries,
+)
+from apex_tpu.lint.scaling import registry as sreg  # noqa: E402
+from apex_tpu.lint.traced.registry import _sds  # noqa: E402
+
+MOD = "apex_tpu.lint"  # attribution target for synthetic entries
+
+CP_GRID = (MeshShape(dp=1, tp=1, cp=2), MeshShape(dp=1, tp=1, cp=4))
+DP_GRID = (MeshShape(dp=2), MeshShape(dp=4), MeshShape(dp=8))
+
+#: a well-formed empty manifest — tests that exercise the per-mesh row
+#: gate build their rows on top of this instead of reading the repo's
+#: committed budgets.json
+_EMPTY_MANIFEST = {"version": 1, "tolerance": 0.1, "entries": {}}
+
+
+def _codes(entries, manifest=None):
+    return [f.code for f in run_entries(entries, manifest=manifest)]
+
+
+def _findings(entries, manifest=None):
+    return run_entries(entries, manifest=manifest)
+
+
+def _manifest_for(entry):
+    """Stage the entry and pin its per-mesh rows, the way
+    --write-budgets would."""
+    from apex_tpu.lint.traced import budgets
+
+    reports = [s.report for s in sreg.stage_entry(entry)]
+    return budgets.build_manifest(reports, previous=_EMPTY_MANIFEST)
+
+
+# ---------------------------------------------------------------------------
+# grid
+# ---------------------------------------------------------------------------
+
+def test_mesh_shape_tags_round_trip():
+    for shape in FULL_GRID:
+        assert parse_tag(shape.tag) == shape
+    assert MeshShape(dp=4, tp=2).tag == "dp4xtp2"
+    assert MeshShape(dp=1, tp=1, cp=2).tag == "dp1xtp1xcp2"
+    with pytest.raises(ValueError):
+        parse_tag("dp4tp2")
+
+
+def test_grid_covers_acceptance_floor():
+    # the tier's contract: >= 6 distinct shapes, all on the 8-device
+    # CPU world, sweeping dp, tp, and cp
+    assert len(set(FULL_GRID)) >= 6
+    assert all(s.devices <= 8 for s in FULL_GRID)
+    assert {s.dp for s in FULL_GRID} >= {2, 4, 8}
+    assert {s.tp for s in FULL_GRID} >= {1, 2}
+    assert any(s.cp > 1 for s in FULL_GRID)
+
+
+# ---------------------------------------------------------------------------
+# APX901 — schedule isomorphism across shapes
+# ---------------------------------------------------------------------------
+
+def _ring_parts(shape, perm_of=None):
+    """A context-ring halo step; ``perm_of`` overrides how the ppermute
+    permutation is derived from the ring size (the seam APX901 guards)."""
+    from apex_tpu.transformer import parallel_state as ps
+
+    n = shape.cp
+    perm = (perm_of or (lambda k: [(i, (i + 1) % k) for i in range(k)]))(n)
+
+    def body(x):
+        h = lax.ppermute(x, ps.CONTEXT_AXIS, perm=perm)
+        return x + h
+
+    fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                      out_specs=P(ps.CONTEXT_AXIS))
+    return fn, (_sds((8, 4), "float32"),), None
+
+
+def _ring_entry(name, build):
+    return ScalingEntry(name, MOD, build=build, grid=CP_GRID,
+                        checks=("schedule",))
+
+
+def test_apx901_clean_ring_sweeps_clean():
+    clean = _ring_entry("ring", lambda s: _ring_parts(s))
+    assert _codes([clean]) == []
+
+
+def test_apx901_reverse_ring_is_isomorphic():
+    # shift(-1) at cp2 coincides with shift(+1); sweeping to cp4 must
+    # not flag a consistently reversed ring
+    rev = _ring_entry("rev", lambda s: _ring_parts(
+        s, perm_of=lambda k: [(i, (i - 1) % k) for i in range(k)]))
+    assert _codes([rev]) == []
+
+
+def test_apx901_hardcoded_perm_fires_on_sweep():
+    # [(0,1),(1,0)] is a legal 2-ring; at cp4 it is an explicit pair
+    # list, not a rotation — the classic hardcoded mesh size
+    bad = _ring_entry("hard", lambda s: _ring_parts(
+        s, perm_of=lambda k: [(0, 1), (1, 0)]))
+    findings = _findings([bad])
+    assert any(f.code == "APX901" and "not scale-invariant"
+               in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_apx901_mesh_sized_structure_fires():
+    # an extra collective that only exists at one swept size
+    from apex_tpu.transformer import parallel_state as ps
+
+    def build(shape):
+        def body(x):
+            y = lax.psum(x, ps.CONTEXT_AXIS)
+            if shape.cp == 4:  # builder branches on the mesh size
+                y = y + lax.pmax(x, ps.CONTEXT_AXIS)
+            return y
+
+        fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                          out_specs=P())
+        return fn, (_sds((8, 4), "float32"),), None
+
+    findings = _findings([_ring_entry("sized", build)])
+    assert any(f.code == "APX901" and "not scale-invariant"
+               in f.message for f in findings)
+
+
+def test_apx901_perm_normalization_units():
+    from apex_tpu.lint.scaling import isomorphism as iso
+
+    assert iso._classify_perm(((0, 1), (1, 2), (2, 3), (3, 0)), 4) \
+        == ("shift", 1, 4)
+    assert iso._classify_perm(((0, 1), (1, 0)), 2) == ("shift", 1, 2)
+    assert iso._classify_perm(((0, 1), (1, 0)), 4)[0] == "perm"
+    assert iso._shift_equal(("shift", 1, 2), ("shift", 3, 4))
+    assert not iso._shift_equal(("shift", 1, 4), ("shift", 3, 4))
+    assert iso._shift_equal(("shift", 7, 8), ("shift", 3, 4))  # both -1
+
+
+# ---------------------------------------------------------------------------
+# APX902 — volume scaling law + per-mesh pinned rows
+# ---------------------------------------------------------------------------
+
+def _psum_parts(shape, rows=8):
+    from apex_tpu.transformer import parallel_state as ps
+
+    def body(x):
+        return lax.psum(x, ps.CONTEXT_AXIS)
+
+    fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                      out_specs=P())
+    return fn, (_sds((rows * shape.cp, 4), "float32"),), None
+
+
+def _vol_entry(name, build, model=None):
+    return ScalingEntry(name, MOD, build=build, grid=CP_GRID,
+                        checks=("volume",), volume_model=model)
+
+
+def test_apx902_linear_law_fits_clean():
+    # fixed local operand -> priced psum bytes linear in cp, matching
+    # the declared one-term model; rows pinned from a fresh stage
+    e = _vol_entry("lin", lambda s: _psum_parts(s),
+                   model=lambda: {"psum": (("cp", lambda s: float(s.cp)),)})
+    assert _codes([e], manifest=_manifest_for(e)) == []
+
+
+def test_apx902_super_linear_misses_declared_law():
+    # operand grows with cp -> priced bytes quadratic vs the declared
+    # linear model
+    e = _vol_entry("quad", lambda s: _psum_parts(s, rows=8 * s.cp),
+                   model=lambda: {"psum": (("cp", lambda s: float(s.cp)),)})
+    findings = _findings([e], manifest=_manifest_for(e))
+    assert any(f.code == "APX902" and "does not follow the declared law"
+               in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_apx902_unmodeled_drift_guard():
+    # same quadratic growth with NO declared model: the generic
+    # super-linear guard along the cp axis must fire
+    e = _vol_entry("drift", lambda s: _psum_parts(s, rows=8 * s.cp))
+    findings = _findings([e], manifest=_manifest_for(e))
+    assert any(f.code == "APX902" and "super-linearly" in f.message
+               for f in findings)
+
+
+def test_apx902_missing_and_drifted_rows():
+    e = _vol_entry("rows", lambda s: _psum_parts(s),
+                   model=lambda: {"psum": (("cp", lambda s: float(s.cp)),)})
+    findings = _findings([e], manifest=_EMPTY_MANIFEST)
+    missing = [f for f in findings if "no per-mesh budget row"
+               in f.message]
+    assert len(missing) == len(CP_GRID), \
+        [f.render() for f in findings]
+
+    pinned = _manifest_for(e)
+    name = "rows@dp1xtp1xcp2"
+    pinned["entries"][name]["collective_bytes"] += 1
+    findings = _findings([e], manifest=pinned)
+    assert any(f.code == "APX902" and "!= pinned" in f.message
+               for f in findings)
+
+
+def test_apx902_stale_row_and_missing_manifest():
+    from apex_tpu.lint.scaling import volume
+
+    stale = {"version": 1, "tolerance": 0.1, "entries": {
+        "rows@dp64xtp1": {"hbm_bytes": 1, "hbm_ceiling": 1,
+                          "collective_bytes": 1, "peak_live_bytes": 1,
+                          "peak_live_cap": 1},
+        "a_base_row": {"hbm_bytes": 1, "hbm_ceiling": 1,
+                       "collective_bytes": 1, "peak_live_bytes": 1,
+                       "peak_live_cap": 1}}}
+    findings = volume.check_manifest_rows(
+        {"rows": {"dp1xtp1xcp2"}}, stale)
+    assert len(findings) == 1  # the @-row, never the base row
+    assert "rows@dp64xtp1" in findings[0].message
+
+    findings = volume.check_manifest_rows({"rows": {"t"}}, None)
+    assert len(findings) == 1 and "does not exist" in findings[0].message
+
+
+def test_apx902_fit_recovers_exact_coefficients():
+    from apex_tpu.lint.scaling.volume import fit
+
+    shapes = DP_GRID
+    basis = (("dp", lambda s: float(s.dp)), ("1", lambda s: 1.0))
+    measured = [100.0 * s.dp + 7.0 for s in shapes]
+    coeffs, preds = fit(basis, shapes, measured)
+    assert coeffs[0] == pytest.approx(100.0)
+    assert coeffs[1] == pytest.approx(7.0)
+    assert preds == pytest.approx(measured)
+
+
+# ---------------------------------------------------------------------------
+# APX903 — per-device memory monotonicity + taint re-run
+# ---------------------------------------------------------------------------
+
+def _dp_parts(shape, local_rows=None):
+    from apex_tpu.transformer import parallel_state as ps
+
+    def body(x):
+        if local_rows is not None:
+            # per-device scratch whose size tracks the mesh — the bug
+            x = x + jnp.zeros((local_rows(shape), 4), jnp.float32).sum()
+        return lax.psum(x, ps.DATA_AXIS)
+
+    fn = ps.shard_map(body, in_specs=(P(ps.DATA_AXIS),),
+                      out_specs=P())
+    return fn, (_sds((8 * shape.dp, 4), "float32"),), None
+
+
+def _mem_entry(name, build, state_bytes=None):
+    return ScalingEntry(name, MOD, build=build, grid=DP_GRID,
+                        checks=("memory",), state_bytes=state_bytes)
+
+
+def test_apx903_shrinking_state_and_peak_clean():
+    e = _mem_entry("ok", lambda s: _dp_parts(s),
+                   state_bytes=lambda s: 4096 // s.dp)
+    assert _codes([e]) == []
+
+
+def test_apx903_growing_state_bytes_fires():
+    e = _mem_entry("grow", lambda s: _dp_parts(s),
+                   state_bytes=lambda s: 1024 * s.dp)
+    findings = _findings([e])
+    assert any(f.code == "APX903" and "optimizer-state bytes"
+               in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_apx903_growing_peak_live_fires():
+    e = _mem_entry("peak", lambda s: _dp_parts(
+        s, local_rows=lambda shape: 64 * shape.dp))
+    findings = _findings([e])
+    assert any(f.code == "APX903" and "peak-live" in f.message
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# APX904 — rule-table scale safety
+# ---------------------------------------------------------------------------
+
+def _table_entry(name, heads, extra_rules=()):
+    from apex_tpu.transformer import parallel_state as ps
+
+    rules = ((r"(^|/)heads$", P(None, ps.TENSOR_AXIS)),
+             (r"(^|/)bias$", P())) + tuple(extra_rules)
+    trees = {"params": {"heads": _sds((4, heads, 16), "float32"),
+                        "bias": _sds((16,), "float32")}}
+    return ScalingEntry(name, MOD, checks=("tables",),
+                        rules=lambda: rules, trees=lambda: trees,
+                        grid=FULL_GRID)
+
+
+def test_apx904_indivisible_head_axis_fires():
+    # heads=2 divides tp<=2 but not the swept tp=4 — the exact bug
+    # class the sweep exists to catch before an 8-chip pod does
+    findings = _findings([_table_entry("h2", heads=2)])
+    assert any(f.code == "APX904" and "does not divide" in f.message
+               and "dp2xtp4" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_apx904_divisible_head_axis_clean():
+    assert _codes([_table_entry("h8", heads=8)]) == []
+
+
+def test_apx904_dead_rule_recoded_from_apx701():
+    findings = _findings([_table_entry(
+        "dead", heads=8,
+        extra_rules=((r"(^|/)nonexistent$", P()),))])
+    assert any(f.code == "APX904" and "dead rule" in f.message
+               for f in findings)
+
+
+def test_draft_gpt_medium_heads_divide_swept_tp():
+    # regression for the real APX904 finding this tier surfaced: the
+    # medium drafter shipped num_heads=2, indivisible at swept tp=4 —
+    # its KV-cache head axis must divide every tp the grid sweeps
+    from apex_tpu.models.gpt import draft_gpt_medium
+
+    cfg = draft_gpt_medium()
+    for tp in {s.tp for s in FULL_GRID}:
+        assert cfg.num_heads % tp == 0, (cfg.num_heads, tp)
+    # and the registered table entry is clean end-to-end
+    entries = [e for e in sreg.repo_entries()
+               if e.name == "gpt_draft_medium_rules_scale"]
+    assert len(entries) == 1
+    assert _codes(entries) == []
+
+
+# ---------------------------------------------------------------------------
+# seeded-bug meta-tests
+# ---------------------------------------------------------------------------
+
+def test_seeded_hardcoded_rank_count_fires_apx901():
+    """A schedule gated on ``axis_index < 2``: uniform (and clean) on
+    the 2-ring anchor shape, divergent the moment the sweep reaches
+    cp=4 — the APX511 re-issue fires under the shape tag."""
+    from apex_tpu.transformer import parallel_state as ps
+
+    def build(shape):
+        def body(x):
+            i = lax.axis_index(ps.CONTEXT_AXIS)
+            return lax.cond(
+                i < 2,  # hardcoded rank count
+                lambda v: lax.psum(v, ps.CONTEXT_AXIS),
+                lambda v: v * 2.0, x)
+
+        fn = ps.shard_map(body, in_specs=(P(ps.CONTEXT_AXIS),),
+                          out_specs=P(ps.CONTEXT_AXIS))
+        return fn, (_sds((8, 4), "float32"),), None
+
+    findings = _findings([_ring_entry("ranks", build)])
+    tagged = [f for f in findings if f.code == "APX901"
+              and "[dp1xtp1xcp4]" in f.message]
+    assert tagged, [f.render() for f in findings]
+    # the anchor shape alone would have passed
+    anchor = ScalingEntry("anchor", MOD, build=build,
+                          grid=(MeshShape(dp=1, tp=1, cp=2),),
+                          checks=("schedule",))
+    assert _codes([anchor]) == []
+
+
+def test_seeded_zero_spec_flip_fires_apx903():
+    """A ZeRO-style step whose optimizer state the program wires
+    replicated (every rank keeps the full buffer and dynamic-updates
+    its slice) while the declared contract still says row-sharded —
+    the APX703 re-run fires APX903 at every swept shape."""
+    from apex_tpu.transformer import parallel_state as ps
+
+    def parts(shape, flipped):
+        dp = shape.dp
+        rows = 64 * dp  # global state rows
+
+        def step_sharded(m, g):
+            gs = lax.psum_scatter(g, ps.DATA_AXIS,
+                                  scatter_dimension=0, tiled=True)
+            return m + gs
+
+        def step_replicated(m, g):
+            gs = lax.psum_scatter(g, ps.DATA_AXIS,
+                                  scatter_dimension=0, tiled=True)
+            i = lax.axis_index(ps.DATA_AXIS)
+            off = i * gs.shape[0]
+            mine = lax.dynamic_slice_in_dim(m, off, gs.shape[0], 0)
+            return lax.dynamic_update_slice_in_dim(
+                m, mine + gs, off, 0)
+
+        contract = (P(ps.DATA_AXIS), P(ps.DATA_AXIS))  # the rule table
+        wired = (P(), P(ps.DATA_AXIS)) if flipped else contract
+        fn = ps.shard_map(
+            step_replicated if flipped else step_sharded,
+            in_specs=wired, out_specs=wired[0])
+        # state is 1/dp of the grads either way; only its wiring flips
+        args = (_sds((rows // dp, 4), "float32"),
+                _sds((rows, 4), "float32"))
+        return fn, args, contract
+
+    bad = _mem_entry("flip", lambda s: parts(s, flipped=True))
+    findings = _findings([bad])
+    tagged = [f for f in findings if f.code == "APX903"
+              and "does not shard what the table says" in f.message]
+    assert len(tagged) == len(DP_GRID), \
+        [f.render() for f in findings]
+    assert all(f"[{s.tag}]" in f.message
+               for s, f in zip(DP_GRID, tagged))
+
+    clean = _mem_entry("noflip", lambda s: parts(s, flipped=False))
+    assert _codes([clean]) == []
+
+
+def test_stage_failure_is_apx100_not_silent():
+    def broken(shape):
+        raise RuntimeError("boom")
+
+    findings = _findings([ScalingEntry(
+        "broken", MOD, build=broken, grid=CP_GRID,
+        checks=("schedule",))])
+    assert [f.code for f in findings] == ["APX100"] * len(CP_GRID)
+    assert "boom" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# registry + CLI integration
+# ---------------------------------------------------------------------------
+
+def test_scaling_registry_populated_and_clean():
+    entries = sreg.repo_entries()
+    assert len(entries) >= 4, [e.name for e in entries]
+    # both sweep archetypes present: a dp x tp program and a cp ring
+    swept = [e for e in entries if e.build is not None]
+    assert any(any(s.tp > 1 for s in e.grid) for e in swept)
+    assert any(any(s.cp > 1 for s in e.grid) for e in swept)
+    findings = sreg.check_repo()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_budgets_json_pins_per_mesh_rows():
+    from apex_tpu.lint.traced import budgets
+
+    manifest = budgets.load_manifest()
+    assert manifest is not None
+    rows = {n for n in manifest["entries"] if "@" in n}
+    # every swept shape of every program entry has its pinned row
+    for e in sreg.repo_entries():
+        if e.build is None:
+            continue
+        base = e.budget_name or e.name
+        for s in e.grid:
+            assert f"{base}@{s.tag}" in rows, (base, s.tag)
+
+
+def test_cost_tier_ignores_per_mesh_rows():
+    # base cost reports alone must not flag the @-rows as stale
+    from apex_tpu.lint.traced import budgets
+
+    manifest = {"version": 1, "tolerance": 0.1, "entries": {
+        "zzz@dp2xtp1": {"hbm_bytes": 1, "hbm_ceiling": 1,
+                        "collective_bytes": 1, "peak_live_bytes": 1,
+                        "peak_live_cap": 1}}}
+    assert budgets.check([], manifest) == []
+
+
+def test_cli_codes_apx9_glob_enables_tier(monkeypatch, capsys):
+    from apex_tpu.lint import scaling
+    from apex_tpu.lint.__main__ import main
+
+    # a fast known-bad registry: the glob must reach it end-to-end
+    monkeypatch.setattr(scaling, "repo_entries",
+                        lambda: [_table_entry("h2", heads=2)])
+    assert main(["--no-trace", "--codes", "APX9*"]) == 1
+    out = capsys.readouterr().out
+    assert "APX904" in out and "does not divide" in out
+    # without the glob the same registry is never consulted
+    assert main(["--no-trace"]) == 0
+
+
+def test_cli_scaling_flag(monkeypatch):
+    from apex_tpu.lint import scaling
+    from apex_tpu.lint.__main__ import main
+
+    monkeypatch.setattr(scaling, "repo_entries",
+                        lambda: [_table_entry("h8", heads=8)])
+    assert main(["--no-trace", "--scaling"]) == 0
+    monkeypatch.setattr(scaling, "repo_entries",
+                        lambda: [_table_entry("h2", heads=2)])
+    assert main(["--no-trace", "--scaling"]) == 1

@@ -423,7 +423,7 @@ def test_cli_codes_selects_matched_codes_only():
 def test_cli_codes_rejects_unknown_pattern(capsys):
     from apex_tpu.lint.__main__ import main
 
-    assert main(["--no-trace", "--codes", "APX9*"]) == 2
+    assert main(["--no-trace", "--codes", "APX97*"]) == 2
     assert "matches no known code" in capsys.readouterr().err
 
 
