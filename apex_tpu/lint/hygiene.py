@@ -69,13 +69,16 @@ _HOST_STATE_MODULES = {"apex_tpu.serving.faults",
                        "apex_tpu.serving.health",
                        "apex_tpu.serving.observe",
                        "apex_tpu.serving.transfer",
-                       "apex_tpu.serving.router"}
+                       "apex_tpu.serving.router",
+                       "apex_tpu.serving.tenancy",
+                       "apex_tpu.serving.streaming"}
 #: The stateful classes those modules export (re-exported by
 #: ``apex_tpu.serving``); instances are mutated on the host every tick.
 _HOST_STATE_SYMBOLS = {"FaultInjector", "ServingStats", "Tracer",
                        "MetricsRegistry", "FlightRecorder",
                        "PageTransfer", "ReplicaHealth",
-                       "DisaggregatedRouter"}
+                       "DisaggregatedRouter", "TenancyPolicy",
+                       "StreamMux"}
 
 
 def _host_modules(tree: ast.Module) -> Dict[str, str]:

@@ -74,6 +74,19 @@ this package is that path for ``apex_tpu.models.gpt``, TPU-first:
   one admission queue, load-based prefill routing, headroom-chosen
   decode placement with N-way failover, per-link-priced reshard
   handoffs, and the same bit-identical stream contract.
+- ``tenancy``   — the multi-tenant front-end policy: ``Tenant``
+  configs (weight, page quota, priority rung, TTFT/ITL SLO bounds)
+  behind a ``TenancyPolicy`` the scheduler consults for stride-clock
+  weighted fair share over the tick token budget, page-quota
+  reservations charged against the pool's ``QuotaLedger``, and
+  priority preemption-by-requeue — reordering WHEN work runs, never
+  WHAT commits (streams stay integer-identical to the untenanted
+  scheduler);
+- ``streaming`` — per-token delivery: a ``TokenStream`` per request
+  fed by a ``StreamMux`` the scheduler flushes once per tick (1..k+1
+  tokens per speculative commit), with a ``stream_emit`` fault site
+  and a strict-prefix contract on failure — delivery is host-side
+  fan-out, never part of the committed stream.
 """
 
 from apex_tpu.serving.cache import (  # noqa: F401
@@ -99,16 +112,17 @@ from apex_tpu.serving.faults import (  # noqa: F401
 from apex_tpu.serving.health import (  # noqa: F401
     FINISH_REASONS, HEALTH_STATES, AdmissionRejected, DeadlineExceeded,
     LivelockError, NonFiniteLogits, PoolExhausted, PoolInvariantError,
-    PromoteFailed, ReplicaHealth, ReplicaUnavailable, RequestOutcome,
-    ReshardFailed, RetryBudgetExhausted, ServingError, ServingStats,
-    SpillFailed, TransferCorrupt, TransferFailed,
+    PromoteFailed, QuotaExhausted, ReplicaHealth, ReplicaUnavailable,
+    RequestOutcome, ReshardFailed, RetryBudgetExhausted, ServingError,
+    ServingStats, SloViolation, SpillFailed, StreamFailed,
+    TransferCorrupt, TransferFailed,
 )
 from apex_tpu.serving.observe import (  # noqa: F401
     FlightRecorder, MetricsRegistry, TraceEvent, Tracer,
 )
 from apex_tpu.serving.paging import (  # noqa: F401
     PAGE_KEY_VERSION, SPILL_DTYPE_TAGS, PagePool, PrefixRegistry,
-    SpillRecord, decode_spill_header, encode_spill_header,
+    QuotaLedger, SpillRecord, decode_spill_header, encode_spill_header,
     prefix_page_keys, spill_checksum,
 )
 from apex_tpu.serving.router import (  # noqa: F401
@@ -120,6 +134,12 @@ from apex_tpu.serving.sampling import (  # noqa: F401
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler, DecodeEngine, PagedDecodeEngine, Request,
+)
+from apex_tpu.serving.streaming import (  # noqa: F401
+    StreamMux, TokenStream,
+)
+from apex_tpu.serving.tenancy import (  # noqa: F401
+    DEFAULT_TENANT, Tenant, TenancyPolicy,
 )
 from apex_tpu.serving.transfer import (  # noqa: F401
     PageReshard, PageTransfer, make_extract_pages_fn,

@@ -99,6 +99,15 @@ executables stay fault-free):
                    the FIRST routable prefill replica in fixed pool
                    order — a routing-policy fault, never a stream
                    fault (placement cannot move committed tokens)
+``stream_emit``    one per-token stream delivery batch is dropped
+                   (``serving.streaming.StreamMux`` draws once per
+                   request with staged tokens at each end-of-tick
+                   flush, in sorted request order, typed
+                   :class:`~apex_tpu.serving.health.StreamFailed`).
+                   The batch is discarded and the stream CLOSES — the
+                   delivered tokens stay a strict prefix of the
+                   committed outcome; the request itself keeps
+                   decoding, so committed streams are untouched
 =================  ======================================================
 
 This module is host state (counters + schedules); reading it from
@@ -113,7 +122,7 @@ from typing import Dict, Iterable, Mapping, Optional, Tuple
 SITES = ("pool_alloc", "cow_clone", "prefill_exec", "chunk_prefill_exec",
          "decode_exec", "sample", "draft_exec", "page_send", "page_recv",
          "replica_health", "host_spill", "host_promote", "reshard_send",
-         "reshard_recv", "pool_route")
+         "reshard_recv", "pool_route", "stream_emit")
 
 #: Per-site contract: ``site -> (typed degrade error | None,
 #: CI chaos-matrix sweep env | None)``. The error is the
@@ -143,6 +152,7 @@ SITE_CONTRACTS = {
     "reshard_send": ("ReshardFailed", "APEX_CHAOS_POOL_SEED"),
     "reshard_recv": ("ReshardFailed", "APEX_CHAOS_POOL_SEED"),
     "pool_route": (None, "APEX_CHAOS_POOL_SEED"),
+    "stream_emit": ("StreamFailed", "APEX_CHAOS_TENANT_SEED"),
 }
 
 

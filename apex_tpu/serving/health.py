@@ -71,6 +71,19 @@ Taxonomy (all subclass :class:`ServingError`):
                             drift); the stale host-tier entry is dropped
                             and the admission degrades to re-prefilling
                             the uncovered remainder of the prompt
+:class:`StreamFailed`       a per-token stream delivery batch was dropped
+                            at the ``stream_emit`` fault site; the stream
+                            closes and its delivered tokens remain a
+                            STRICT PREFIX of the committed outcome — the
+                            request itself is never perturbed
+:class:`QuotaExhausted`     a tenant's page quota cannot cover a request's
+                            worst-case page reservation — raised at
+                            ``submit()`` (the tenancy analogue of
+                            :class:`AdmissionRejected` backpressure)
+:class:`SloViolation`       a finished request broke its tenant's declared
+                            TTFT/ITL tick bound; attached to
+                            ``RequestOutcome.slo`` as a diagnostic (the
+                            outcome itself stays healthy)
 ==========================  ===============================================
 
 The disaggregated tier adds one piece of host-side *state* here too:
@@ -262,6 +275,66 @@ class PromoteFailed(ServingError):
         self.payload.update(key=key, pages=pages)
 
 
+class StreamFailed(ServingError):
+    """A per-token stream delivery batch was dropped: the
+    ``stream_emit`` fault site fired while the
+    :class:`~apex_tpu.serving.streaming.StreamMux` was flushing a
+    request's staged tokens. The batch is discarded and the stream
+    CLOSES — its ``delivered`` tokens stay a strict prefix of the
+    committed ``RequestOutcome.tokens`` — while the request itself
+    keeps decoding untouched (stream delivery is host-side fan-out,
+    never part of the committed-stream contract)."""
+
+    def __init__(self, msg: str, *, request_id: int = -1,
+                 delivered: int = 0, dropped: int = 0):
+        super().__init__(msg)
+        self.request_id = request_id
+        self.delivered = delivered
+        self.dropped = dropped
+        self.payload.update(request_id=request_id, delivered=delivered,
+                            dropped=dropped)
+
+
+class QuotaExhausted(ServingError):
+    """A tenant's page quota cannot cover a request's worst-case page
+    reservation (prompt + ``max_new_tokens`` + speculative headroom,
+    priced by the paged engine's geometry). Raised by ``submit()`` when
+    the request could NEVER fit its tenant's quota — the tenancy
+    analogue of :class:`AdmissionRejected` backpressure. Transient
+    quota pressure (the tenant's other live requests hold the pages)
+    never raises: admission simply defers the request until a
+    completion credits the reservation back."""
+
+    def __init__(self, msg: str, *, tenant: str = "", need: int = 0,
+                 quota: int = 0, charged: int = 0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.need = need
+        self.quota = quota
+        self.charged = charged
+        self.payload.update(tenant=tenant, need=need, quota=quota,
+                            charged=charged)
+
+
+class SloViolation(ServingError):
+    """A finished request broke its tenant's declared service-level
+    objective: TTFT or worst-case inter-token latency exceeded the
+    tenant's tick bound. Never raised — the scheduler stamps it into
+    ``RequestOutcome.slo`` as a typed diagnostic (the outcome's
+    ``error``/``ok`` contract is untouched: an SLO miss is a latency
+    fact, not a failure) and bumps the ``slo_violations`` counter."""
+
+    def __init__(self, msg: str, *, tenant: str = "", metric: str = "",
+                 observed: int = 0, bound: int = 0):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.metric = metric
+        self.observed = observed
+        self.bound = bound
+        self.payload.update(tenant=tenant, metric=metric,
+                            observed=observed, bound=bound)
+
+
 #: ``ReplicaHealth`` states, worst first. The index doubles as the
 #: ``serving_replica_health`` gauge value (0 = down .. 2 = healthy) so
 #: dashboards can alert on ``< 2`` without string labels.
@@ -383,6 +456,14 @@ STAT_FIELDS = {
     "host_promote_failures": "promotions abandoned (fault/verification)",
     "host_promote_bytes": "payload bytes promoted from the host tier",
     "host_promote_ticks": "tick-clock cost charged for promotions",
+    "stream_batches": "per-token stream batches delivered",
+    "stream_tokens": "tokens delivered through token streams",
+    "stream_failures": "stream_emit faults: streams closed early",
+    "quota_exhausted": "submits refused on tenant page quota",
+    "quota_deferrals": "admissions deferred on tenant quota pressure",
+    "chunk_deferrals": "prefill chunks deferred on fair-share overrun",
+    "tenant_preemptions": "slots requeued for a higher-priority tenant",
+    "slo_violations": "finished requests that broke their tenant SLO",
 }
 
 
@@ -471,7 +552,14 @@ class RequestOutcome:
     the ticks that ran prefill work for the request (1 on the
     monolithic path; the number of chunk-carrying ticks, across
     retries, when chunked prefill is on) — ``None`` when the request
-    never reached prefill."""
+    never reached prefill.
+
+    ``tenant_id`` names the tenant the request was submitted under
+    (``"default"`` when tenancy is off — byte-compatible with the
+    untenanted scheduler). ``slo`` carries a typed
+    :class:`SloViolation` when the request finished outside its
+    tenant's declared TTFT/ITL bounds; it is a latency diagnostic,
+    not a failure — ``ok`` looks only at ``error``."""
 
     tokens: Tuple[int, ...]
     reason: str
@@ -480,6 +568,8 @@ class RequestOutcome:
     ttft_ticks: Optional[int] = None
     total_ticks: Optional[int] = None
     prefill_ticks: Optional[int] = None
+    tenant_id: str = "default"
+    slo: Optional[ServingError] = None
 
     @property
     def ok(self) -> bool:

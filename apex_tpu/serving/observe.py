@@ -69,7 +69,8 @@ PHASES = ("prefill", "draft", "prepare_decode", "exec", "accept",
 #: every ``instant`` emit site against this tuple.)
 LIFECYCLE = ("submitted", "admitted", "first_token",
              "preempted", "retried", "quarantined", "failover",
-             "finished", "host_spill", "host_promote", "rebalance")
+             "finished", "host_spill", "host_promote", "rebalance",
+             "stream_emit", "slo_violation")
 
 #: Default histogram buckets for tick-denominated latencies (TTFT,
 #: inter-token). Roughly geometric: fine where SLOs live, coarse in
@@ -450,6 +451,69 @@ class Tracer:
                 help="inter-token gap, in scheduler ticks (0 within a "
                      "multi-token speculative commit)")
         h.observe(ticks)
+
+    def observe_tenant_ttft(self, tenant: str, ticks: int) -> None:
+        h = self._hot.get(("tttft", tenant))
+        if h is None:
+            h = self._hot[("tttft", tenant)] = self.registry.histogram(
+                "serving_tenant_ttft_ticks",
+                help="submit -> first committed token, in scheduler "
+                     "ticks, per tenant",
+                labels={"tenant": tenant})
+        h.observe(ticks)
+
+    def observe_tenant_itl(self, tenant: str, ticks: int) -> None:
+        h = self._hot.get(("titl", tenant))
+        if h is None:
+            h = self._hot[("titl", tenant)] = self.registry.histogram(
+                "serving_tenant_itl_ticks",
+                help="inter-token gap, in scheduler ticks, per tenant",
+                labels={"tenant": tenant})
+        h.observe(ticks)
+
+    def tenant_gauges(self, snapshot: Dict[str, Dict[str, float]]) -> None:
+        """End-of-tick tenancy rollup: per-tenant page reservations,
+        fair-share virtual time, and cumulative committed tokens
+        (``snapshot`` comes from ``TenancyPolicy.gauge_snapshot``)."""
+        hot = self._hot
+        for tenant in sorted(snapshot):
+            gs = hot.get(("tenant", tenant))
+            if gs is None:
+                r = self.registry
+                gs = hot[("tenant", tenant)] = (
+                    r.gauge("serving_tenant_pages_charged",
+                            help="pages reserved against the tenant's "
+                                 "quota by its live requests",
+                            labels={"tenant": tenant}),
+                    r.gauge("serving_tenant_share_vtime",
+                            help="weighted fair-share virtual time "
+                                 "(charged tokens / weight) — tenants "
+                                 "advance together when shares match "
+                                 "their weights",
+                            labels={"tenant": tenant}),
+                    r.gauge("serving_tenant_tokens",
+                            help="tokens charged to the tenant so far "
+                                 "(committed + prefill chunk tokens)",
+                            labels={"tenant": tenant}))
+            g_pages, g_vtime, g_tokens = gs
+            row = snapshot[tenant]
+            g_pages.set(row["pages"])
+            g_vtime.set(row["vtime"])
+            g_tokens.set(row["tokens"])
+
+    def tenant_latency_summary(self, tenant: str) -> Dict[str, float]:
+        """Per-tenant ``{ttft_p50: ..., itl_p99: ...}`` quantile dict —
+        the bench helper behind the noisy-neighbor contract; silently
+        omits empty histograms."""
+        out: Dict[str, float] = {}
+        for short, name in (("ttft", "serving_tenant_ttft_ticks"),
+                            ("itl", "serving_tenant_itl_ticks")):
+            qs = self.registry.quantiles(name,
+                                         labels={"tenant": tenant})
+            if qs:
+                for tag, v in qs.items():
+                    out[f"{short}_{tag}"] = round(v, 3)
+        return out
 
     def stream_acceptance(self, slot: int, rate: float) -> None:
         g = self._hot.get(("acc", slot))

@@ -65,7 +65,7 @@ import numpy as np
 
 from apex_tpu.serving.cache import RESERVED_PAGES
 from apex_tpu.serving.faults import FaultInjector
-from apex_tpu.serving.health import PoolInvariantError
+from apex_tpu.serving.health import PoolInvariantError, QuotaExhausted
 
 #: Version tag baked into every hashed page record. The chained key is
 #: a CROSS-REPLICA content address (prefix cache, transfer dedup, and
@@ -330,6 +330,88 @@ class PrefixRegistry:
         return True
 
 
+class QuotaLedger:
+    """Per-tenant page-reservation accounting for the tenancy
+    front-end (``serving.tenancy``). Reservations are CONSERVATIVE:
+    a request charges its worst-case page need (prompt +
+    ``max_new_tokens`` + speculative headroom) when it is first
+    admitted and credits it back exactly once, when it finishes —
+    preemption, requeue and retry in between never touch the books,
+    which is what makes the ledger trivially leak-free (every charge
+    has exactly one credit, at the single exit point every request
+    passes through).
+
+    ``quotas`` maps tenant name -> page cap (``None`` = unlimited).
+    The ledger attaches to a :class:`PagePool` (``pool.ledger``) so
+    the chaos tier's per-tick ``check_invariants`` audit covers the
+    tenancy books alongside the refcounts. Host state (APX401).
+    """
+
+    def __init__(self, quotas: Dict[str, Optional[int]]):
+        for tenant in sorted(quotas):
+            q = quotas[tenant]
+            if q is not None and q < 1:
+                raise ValueError(
+                    f"tenant {tenant!r} quota must be >= 1 pages or "
+                    f"None, got {q}")
+        self.quotas: Dict[str, Optional[int]] = dict(quotas)
+        self._charged: Dict[str, int] = {t: 0 for t in quotas}
+
+    def quota(self, tenant: str) -> Optional[int]:
+        return self.quotas.get(tenant)
+
+    def charged(self, tenant: str) -> int:
+        return self._charged.get(tenant, 0)
+
+    def can_charge(self, tenant: str, pages: int) -> bool:
+        q = self.quotas.get(tenant)
+        if q is None:
+            return True
+        return self._charged.get(tenant, 0) + pages <= q
+
+    def charge(self, tenant: str, pages: int) -> None:
+        if not self.can_charge(tenant, pages):
+            q = self.quotas.get(tenant)
+            raise QuotaExhausted(
+                f"tenant {tenant!r}: charging {pages} pages would "
+                f"exceed the {q}-page quota "
+                f"({self._charged.get(tenant, 0)} already reserved)",
+                tenant=tenant, need=pages, quota=q or 0,
+                charged=self._charged.get(tenant, 0))
+        self._charged[tenant] = self._charged.get(tenant, 0) + pages
+
+    def credit(self, tenant: str, pages: int) -> None:
+        held = self._charged.get(tenant, 0)
+        if pages > held:
+            raise PoolInvariantError(
+                f"tenant {tenant!r}: crediting {pages} pages but only "
+                f"{held} are reserved — double credit")
+        self._charged[tenant] = held - pages
+
+    def check(self) -> bool:
+        """Audit the books: reservations non-negative and within each
+        tenant's quota. Raises :class:`PoolInvariantError` on the first
+        inconsistency (the per-tick chaos audit calls this through
+        ``PagePool.check_invariants``)."""
+        for tenant in sorted(self._charged):
+            held = self._charged[tenant]
+            if held < 0:
+                raise PoolInvariantError(
+                    f"tenant {tenant!r}: negative page reservation "
+                    f"{held}")
+            q = self.quotas.get(tenant)
+            if q is not None and held > q:
+                raise PoolInvariantError(
+                    f"tenant {tenant!r}: {held} pages reserved over "
+                    f"the {q}-page quota")
+        return True
+
+    def snapshot(self) -> Dict[str, Dict[str, Optional[int]]]:
+        return {t: {"quota": self.quotas.get(t),
+                    "charged": self._charged.get(t, 0)}
+                for t in sorted(self._charged)}
+
+
 class PagePool:
     """Free list + per-page refcounts + LRU prefix registry (see
     module doc). ``free_order`` overrides the initial free-list order —
@@ -370,6 +452,9 @@ class PagePool:
         # eviction sweep ONLY for pages the registry solely owns
         self.host_tier = host_tier
         self.spill_hook: Optional[Callable[[bytes, int], None]] = None
+        # the tenancy front-end attaches its QuotaLedger here so the
+        # per-tick invariant audit covers the reservation books too
+        self.ledger: Optional[QuotaLedger] = None
 
     # -- refcounting ------------------------------------------------------
 
@@ -535,6 +620,8 @@ class PagePool:
                     f"{self._ref.get(page, 0)}")
         if self.host_tier is not None:
             self.host_tier.check_invariants()
+        if self.ledger is not None:
+            self.ledger.check()
         if slot_pages is not None:
             expected = Counter(registry)
             for slot, pages in enumerate(slot_pages):
@@ -576,4 +663,6 @@ class PagePool:
                 "refcounts": dict(self._ref)}
         if self.host_tier is not None:
             snap["host_tier"] = self.host_tier.stats()
+        if self.ledger is not None:
+            snap["quota_ledger"] = self.ledger.snapshot()
         return snap

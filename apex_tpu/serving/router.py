@@ -519,6 +519,11 @@ class _PoolEngine(_DisaggEngine):
         self._pass_busy: Dict[str, int] = {}
         self._route_hot: Dict[str, object] = {}
         self._load_hot: Dict[str, object] = {}
+        # tenancy threading: last prefill replica routed per tenant —
+        # a deterministic affinity tiebreak in the routing score
+        # (prefix locality for a tenant's traffic), consulted only
+        # when the scheduler stamps admission_tenant (tenancy mode)
+        self._tenant_affinity: Dict[str, str] = {}
 
     # -- pool observability ---------------------------------------------
 
@@ -561,12 +566,29 @@ class _PoolEngine(_DisaggEngine):
     def _load_key(self, name: str):
         """Routing score, lower is better: health rung first (healthy
         before degraded), then link ticks already routed to the
-        replica this pass (queue depth), then pages-free headroom,
-        then fixed pool order."""
+        replica this pass (queue depth), then the admitting tenant's
+        replica affinity (the replica that last served the tenant —
+        prefix locality; a constant when tenancy is off, so the
+        untenanted key is unchanged), then pages-free headroom, then
+        fixed pool order. Placement may shift with tenancy, streams
+        may not: committed tokens are placement-invariant."""
+        tenant = self.admission_tenant
+        affine = 0 if (tenant is not None
+                       and self._tenant_affinity.get(tenant) == name) \
+            else 1
         return (-HEALTH_STATES.index(self.health[name].state),
                 self._pass_busy.get(name, 0),
+                affine,
                 -self._replicas[name].pool.num_free,
                 self._order.index(name))
+
+    def _note_route(self, name: str) -> str:
+        """Record the pick as the admitting tenant's affinity replica
+        for the next admission's tiebreak; returns the pick."""
+        tenant = self.admission_tenant
+        if tenant is not None:
+            self._tenant_affinity[tenant] = name
+        return name
 
     def _route_prefill(self) -> Optional[str]:
         """Pick the prefill replica for one remote admission, or None
@@ -585,9 +607,9 @@ class _PoolEngine(_DisaggEngine):
         if fired:
             self.stats.route_fallbacks += 1
             self._route_mark("fallback")
-            return cands[0]
+            return self._note_route(cands[0])
         self._route_mark("load")
-        return min(cands, key=self._load_key)
+        return self._note_route(min(cands, key=self._load_key))
 
     def prefill(self, slot: int, prompt: Sequence[int]):
         trc = self.tracer

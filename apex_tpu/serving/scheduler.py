@@ -144,8 +144,8 @@ from apex_tpu.serving.draft import ngram_draft, tree_arrays
 from apex_tpu.serving.faults import FaultInjector, InjectedFault
 from apex_tpu.serving.health import (
     AdmissionRejected, DeadlineExceeded, LivelockError, NonFiniteLogits,
-    PoolExhausted, PromoteFailed, RequestOutcome, RetryBudgetExhausted,
-    ServingStats, SpillFailed,
+    PoolExhausted, PromoteFailed, QuotaExhausted, RequestOutcome,
+    RetryBudgetExhausted, ServingStats, SpillFailed,
 )
 from apex_tpu.quant.params import is_quantized_tree
 from apex_tpu.serving.observe import Tracer
@@ -172,12 +172,16 @@ class Request:
     placement and co-tenants). ``deadline_ticks``, when set, bounds the
     request's lifetime in scheduler ticks since submission — a
     deterministic deadline (overruns end in a ``deadline`` outcome with
-    the tokens committed so far)."""
+    the tokens committed so far). ``tenant_id`` names the traffic
+    class the tenancy front-end (``serving.tenancy``) accounts the
+    request under; the default tenant keeps the untenanted scheduler
+    byte-compatible."""
     prompt: Tuple[int, ...]
     max_new_tokens: int = 16
     temperature: float = 0.0
     seed: int = 0
     deadline_ticks: Optional[int] = None
+    tenant_id: str = "default"
 
 
 @dataclasses.dataclass
@@ -215,6 +219,12 @@ class DecodeEngine:
     scheduler shares, a view over the tracer's metrics registry."""
 
     paged = False
+    #: The tenant whose request the scheduler is currently admitting —
+    #: stamped (tenancy mode only) right before ``prefill`` /
+    #: ``begin_chunk_prefill`` so composite engines can thread it into
+    #: their routing observability and affinity tiebreaks
+    #: (``serving.router``). Host state, never read under trace.
+    admission_tenant: Optional[str] = None
 
     def __init__(self, params, cfg: GPTConfig, num_slots: int,
                  max_len: int, cache_dtype=jnp.bfloat16, top_k: int = 0,
@@ -1153,7 +1163,8 @@ class ContinuousBatchingScheduler:
                  max_retries: int = 3, max_queue: Optional[int] = None,
                  watchdog_limit: int = 64, audit: bool = False,
                  chunk_tokens: Optional[int] = None,
-                 tick_token_budget: Optional[int] = None):
+                 tick_token_budget: Optional[int] = None,
+                 tenancy=None, streams=None):
         self.engine = engine
         self.eos_id = eos_id
         self.max_retries = max_retries
@@ -1228,6 +1239,33 @@ class ContinuousBatchingScheduler:
         # ticks so repetitive text can re-earn its depth
         self._accept_ewma = [1.0] * engine.num_slots
         self._probe_every = 16
+        # tenancy front-end (serving.tenancy): admission selection,
+        # quotas, priority preemption, per-tenant SLOs. None keeps the
+        # untenanted FIFO path byte-identical. The quota ledger hangs
+        # under the engine's page pool so the per-tick invariant audit
+        # covers the reservation books.
+        self.tenancy = tenancy
+        if tenancy is not None:
+            if tenancy.needs_quota and not getattr(engine, "paged", False):
+                raise ValueError(
+                    "tenant page quotas price KV pages: they need a "
+                    "paged engine (drop the quotas or use "
+                    "PagedDecodeEngine)")
+            pool = getattr(engine, "pool", None)
+            if pool is not None:
+                pool.ledger = tenancy.ledger
+        # per-token streaming (serving.streaming): streams=True builds
+        # a StreamMux on the engine's injector/tracer/stats; passing a
+        # StreamMux keeps the caller's sink. None disables staging.
+        if streams is True:
+            from apex_tpu.serving.streaming import StreamMux
+            streams = StreamMux(injector=engine.injector,
+                                tracer=engine.tracer, stats=engine.stats)
+        self.streams = streams
+        self._req_tenant: Dict[int, str] = {}
+        # worst inter-token gap per request (tenancy mode only — feeds
+        # the ITL SLO check at finish)
+        self._max_itl: Dict[int, int] = {}
 
     @property
     def clock(self) -> int:
@@ -1269,8 +1307,35 @@ class ContinuousBatchingScheduler:
         self.engine.page_demand(
             len(request.prompt) + request.max_new_tokens
             + self.engine.spec_k)
+        ten = self.tenancy
+        if ten is not None:
+            if not ten.has(request.tenant_id):
+                raise ValueError(
+                    f"unknown tenant {request.tenant_id!r}: declare it "
+                    "in the TenancyPolicy before submitting under it")
+            # the quota analogue of the page_demand fail-fast above: a
+            # request whose worst-case reservation can NEVER fit its
+            # tenant's quota is refused typed at submit, not deferred
+            # forever at admission
+            need = self._quota_need(request)
+            if not ten.fits_quota(request.tenant_id, need):
+                self.stats.quota_exhausted += 1
+                raise QuotaExhausted(
+                    f"request needs {need} pages worst-case but tenant "
+                    f"{request.tenant_id!r} is capped at "
+                    f"{ten.tenants[request.tenant_id].page_quota}",
+                    tenant=request.tenant_id, need=need,
+                    quota=ten.tenants[request.tenant_id].page_quota)
         rid = self._next_id
         self._next_id += 1
+        self._req_tenant[rid] = request.tenant_id
+        if ten is not None:
+            # idle -> backlogged bookkeeping: clamps a RETURNING
+            # tenant's vtime to the busy floor; a tenant with work
+            # already outstanding keeps its fair-share deficit
+            ten.note_enqueued(request.tenant_id)
+        if self.streams is not None:
+            self.streams.open(rid, request.tenant_id)
         # ``at_tick`` backdates the arrival for open-loop drivers: a
         # charged forward can jump the clock PAST a request's true
         # arrival time before the driver gets to submit it, and the
@@ -1308,11 +1373,31 @@ class ContinuousBatchingScheduler:
                 trc.attach(error)  # ship the flight-recorder ring
             trc.instant("finished", request_id=rid, reason=reason,
                         ok=error is None)
+        tenant = self._req_tenant.get(rid, "default")
+        ten = self.tenancy
+        slo = None
+        if ten is not None:
+            # the single exit point every request passes through:
+            # credit the quota reservation here and ONLY here, so the
+            # ledger is leak-free by construction
+            ten.credit(rid)
+            ten.note_finished(tenant)
+            slo = ten.slo_check(tenant, ttft, self._max_itl.get(rid))
+            if slo is not None:
+                self.stats.slo_violations += 1
+                if trc.enabled:
+                    trc.attach(slo)
+                    trc.instant("slo_violation", request_id=rid,
+                                tenant=tenant, metric=slo.metric,
+                                observed=slo.observed, bound=slo.bound)
+        if self.streams is not None:
+            self.streams.finish(rid, reason)
         self.outcomes[rid] = RequestOutcome(
             tuple(int(t) for t in tokens), reason, error,
             retries=self._retries.get(rid, 0),
             ttft_ticks=ttft, total_ticks=total,
-            prefill_ticks=self._prefill_ticks.get(rid))
+            prefill_ticks=self._prefill_ticks.get(rid),
+            tenant_id=tenant, slo=slo)
 
     def _charge_work(self, tokens: int) -> None:
         """Advance the scheduler clock by a prefill forward's
@@ -1343,14 +1428,34 @@ class ContinuousBatchingScheduler:
         their gap records as 0 — honest SLO accounting)."""
         tick = self._tick_no
         trc = self.tracer
+        ten = self.tenancy
         if rid not in self._first_token_tick:
             self._first_token_tick[rid] = tick
             if trc.enabled:
                 trc.instant("first_token", request_id=rid, slot=slot)
                 trc.observe_ttft(tick - self._submit_tick.get(rid, tick))
-        elif trc.enabled:
-            trc.observe_itl(tick - self._last_token_tick[rid])
+                if ten is not None:
+                    trc.observe_tenant_ttft(
+                        self._req_tenant.get(rid, "default"),
+                        tick - self._submit_tick.get(rid, tick))
+        else:
+            gap = tick - self._last_token_tick[rid]
+            if ten is not None and gap > self._max_itl.get(rid, 0):
+                self._max_itl[rid] = gap
+            if trc.enabled:
+                trc.observe_itl(gap)
+                if ten is not None:
+                    trc.observe_tenant_itl(
+                        self._req_tenant.get(rid, "default"), gap)
         self._last_token_tick[rid] = tick
+        if ten is not None:
+            # stride clock: one committed token advances the tenant's
+            # virtual time by 1 / weight
+            ten.charge_tokens(self._req_tenant.get(rid, "default"), 1)
+        if self.streams is not None:
+            # stage for the end-of-tick flush — delivery is host-side
+            # fan-out, the committed stream is already in the slot
+            self.streams.stage(rid, self._slots[slot].generated[-1])
 
     def _charge_retry(self, rid: int) -> bool:
         """Consume one unit of ``rid``'s retry budget; True when the
@@ -1418,9 +1523,121 @@ class ContinuousBatchingScheduler:
                                  f"{s.request.deadline_ticks}-tick "
                                  "deadline mid-decode"))
 
+    # -- tenancy: selection, quotas, priority preemption ------------------
+
+    def _quota_need(self, req: Request) -> int:
+        """Worst-case page reservation for one request: the pages that
+        hold prompt + ``max_new_tokens`` + the verify step's spec_k
+        overshoot, capped at the cache row — the same sizing the
+        submit-time ``page_demand`` fail-fast prices. 0 on dense
+        engines (quotas price KV pages; dense caches are per-slot)."""
+        eng = self.engine
+        page_size = getattr(eng, "page_size", None)
+        if page_size is None:
+            return 0
+        total = min(len(req.prompt) + req.max_new_tokens + eng.spec_k,
+                    eng.max_len)
+        return max_pages_per_slot(total, page_size)
+
+    def _promote_next(self) -> bool:
+        """Tenancy admission selection: rotate the best queued
+        candidate to the queue FRONT (the head-pop admission logic
+        then runs unchanged), preserving relative order among the
+        rest — FIFO within a tenant. The key is the policy's
+        ``(chargeable, priority desc, vtime asc, tenant id)`` with
+        queue position appended, so ties resolve deterministically.
+        Returns False when every candidate's tenant is quota-blocked:
+        admission defers until a completion credits pages back.
+        Untenanted schedulers keep strict FIFO (always True)."""
+        ten = self.tenancy
+        if ten is None:
+            return True
+        best = None
+        best_key = None
+        for idx, (rid, req, _resume) in enumerate(self._queue):
+            chargeable = ten.can_admit(rid, req.tenant_id,
+                                       self._quota_need(req))
+            k = ten.selection_key(req.tenant_id, chargeable) + (idx,)
+            if best_key is None or k < best_key:
+                best_key, best = k, idx
+        if best_key[0] == 1:  # even the best candidate is quota-blocked
+            self.stats.quota_deferrals += 1
+            return False
+        if best:
+            q = self._queue
+            items = list(q)
+            sel = items.pop(best)
+            q.clear()
+            q.append(sel)
+            q.extend(items)
+        return True
+
+    def _charge_head_admission(self, rid: int, req: Request) -> None:
+        """Reserve the queue head's quota pages (idempotent — a
+        preempted request being re-admitted already holds its
+        reservation) and stamp the admitting tenant on the engine for
+        the router's observability/affinity threading. Only called
+        after :meth:`_promote_next` returned True, so the charge
+        cannot fail."""
+        ten = self.tenancy
+        if ten is None:
+            return
+        ten.charge_admission(rid, req.tenant_id, self._quota_need(req))
+        self.engine.admission_tenant = req.tenant_id
+
+    def _preempt_for_priority(self) -> None:
+        """A strictly-higher-priority waiting tenant may requeue ONE
+        resident lower-priority slot per tick — through the exact
+        requeue-resume path pool pressure uses (committed tokens ride
+        along, re-prefilled on re-admission, streams bit-identical),
+        with no retry charged: priority preemption is a capacity
+        decision, not a fault. One victim per tick bounds the churn;
+        a quota-blocked burst preempts nobody (the freed slot could
+        not admit it anyway)."""
+        ten = self.tenancy
+        if ten is None or not self._queue:
+            return
+        if any(s is None for s in self._slots):
+            return  # a free slot serves the burst without eviction
+        best = None
+        best_key = None
+        for idx, (rid, req, _resume) in enumerate(self._queue):
+            chargeable = ten.can_admit(rid, req.tenant_id,
+                                       self._quota_need(req))
+            k = ten.selection_key(req.tenant_id, chargeable) + (idx,)
+            if best_key is None or k < best_key:
+                best_key, best = k, req
+        if best_key[0] == 1:
+            return  # quota-blocked: a preemption could not admit it
+        wait_prio = ten.priority(best.tenant_id)
+        victim = None
+        victim_key = None
+        for i, s in enumerate(self._slots):
+            rung = ten.priority(s.request.tenant_id)
+            if rung >= wait_prio:
+                continue  # only STRICTLY lower rungs are preemptible
+            k = (rung, -s.request_id)  # lowest rung, then newest work
+            if victim_key is None or k < victim_key:
+                victim_key, victim = k, i
+        if victim is None:
+            return
+        s = self._slots[victim]
+        self.stats.tenant_preemptions += 1
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "preempted", request_id=s.request_id, slot=victim,
+                cause="tenant_priority",
+                tenant=self._req_tenant.get(s.request_id, "default"))
+        self._queue.appendleft((s.request_id, s.request,
+                                list(s.generated)))
+        self._slots[victim] = None
+        self.engine.free_slot(victim)
+
     # -- admission / decode ticks -----------------------------------------
 
     def _admit(self) -> None:
+        if self.tenancy is not None:
+            self._preempt_for_priority()
         if self.chunk_tokens is not None:
             self._admit_chunked()
             return
@@ -1428,7 +1645,10 @@ class ContinuousBatchingScheduler:
         for i in range(eng.num_slots):
             if self._slots[i] is not None or not self._queue:
                 continue
+            if not self._promote_next():
+                break
             rid, req, resume = self._queue[0]
+            self._charge_head_admission(rid, req)
             # a preempted request resumes by re-prefilling everything
             # it had produced EXCEPT its last sampled token, which the
             # next decode tick feeds (the normal teacher-forcing shape)
@@ -1530,7 +1750,10 @@ class ContinuousBatchingScheduler:
         for i in range(eng.num_slots):
             if self._slots[i] is not None or not self._queue:
                 continue
+            if not self._promote_next():
+                break
             rid, req, resume = self._queue[0]
+            self._charge_head_admission(rid, req)
             tokens = tuple(req.prompt) + tuple(resume[:-1])
             try:
                 state = eng.begin_chunk_prefill(i, tokens)
@@ -1619,7 +1842,19 @@ class ContinuousBatchingScheduler:
         batch must not starve prefill, or TTFT would be unbounded).
         Slots are ordered earliest-deadline-first with request id as
         the deterministic tiebreak, then round-robined one chunk at a
-        time — fair share across concurrent prefills."""
+        time — fair share across concurrent prefills. Tenancy
+        generalizes the ordering: priority rung first, then the
+        tenant's fair-share vtime, then the EDF + id key — and every
+        chunk's tokens advance the tenant's stride clock, so prefill
+        work is priced against the share exactly like decode. Tenancy
+        also THROTTLES: a tenant whose vtime has run more than one
+        chunk-stride past the busy floor (the minimum vtime among
+        resident tenants) has spent its share this interval, and its
+        chunks defer until the floor catches up — so a flood tenant's
+        prompt ingest converges to its weight ratio instead of
+        consuming the whole leftover budget every tick. The floor
+        tenant itself always qualifies, so a tick with prefill work
+        and no decode can never go progress-free (watchdog-safe)."""
         if not any(s is not None and s.prefill is not None
                    for s in self._slots):
             return
@@ -1632,15 +1867,38 @@ class ContinuousBatchingScheduler:
             dl = s.request.deadline_ticks
             abs_dl = (self._submit_tick.get(s.request_id, 0) + dl
                       if dl is not None else float("inf"))
+            ten = self.tenancy
+            if ten is not None:
+                t = s.request.tenant_id
+                return (-ten.priority(t), ten.vtime(t), abs_dl,
+                        s.request_id)
             return (abs_dl, s.request_id)
 
         order = deque(sorted(
             (i for i, s in enumerate(self._slots)
              if s is not None and s.prefill is not None), key=key))
+        ten = self.tenancy
+        floor = None
+        if ten is not None:
+            for s in self._slots:
+                if s is not None:
+                    v = ten.vtime(s.request.tenant_id)
+                    if floor is None or v < floor:
+                        floor = v
         progressed = set()
         while n_chunks > 0 and order:
             i = order.popleft()
             s = self._slots[i]
+            if ten is not None:
+                t = s.request.tenant_id
+                slack = self.chunk_tokens / ten.tenants[t].weight
+                if ten.vtime(t) > floor + slack:
+                    # over its share this interval: the chunk defers
+                    # until the busy floor catches up (dropped from
+                    # THIS tick's rotation only — the slot re-sorts
+                    # into next tick's order)
+                    self.stats.chunk_deferrals += 1
+                    continue
             p = s.prefill
             n_chunks -= 1
             chunk = p.tokens[p.next:p.next + self.chunk_tokens]
@@ -1654,6 +1912,9 @@ class ContinuousBatchingScheduler:
             self.stats.prefill_chunks += 1
             progressed.add(s.request_id)
             self._charge_work(len(chunk))
+            if self.tenancy is not None:
+                self.tenancy.charge_tokens(s.request.tenant_id,
+                                           len(chunk))
             if final:
                 self._finish_prefill(i, logits)
             else:
@@ -2226,10 +2487,17 @@ class ContinuousBatchingScheduler:
         self._expire_deadlines()
         self._admit()
         self._tick()
+        if self.streams is not None:
+            # end-of-tick delivery: every stream gets exactly the
+            # tokens this tick committed for it (1..k+1 under
+            # speculation), one stream_emit draw per delivering stream
+            self.streams.flush()
         if trc.enabled:
             trc.tick_metrics(self._tokens_emitted - before,
                              len(self._queue),
                              self.engine.pool_gauges())
+            if self.tenancy is not None:
+                trc.tenant_gauges(self.tenancy.gauge_snapshot())
         if self.audit:
             self.engine.check_invariants()
         snap = (self._tokens_emitted, len(self.outcomes),

@@ -691,7 +691,7 @@ def _observed_decode_probe():
 
 _SCENARIO_SEED = {"chat": 101, "batch_completion": 102,
                   "long_context": 103, "shared_prefix": 104,
-                  "cache_hierarchy": 105}
+                  "cache_hierarchy": 105, "multitenant": 106}
 
 
 def _scenario_arrivals(name, vocab):
@@ -753,6 +753,26 @@ def _scenario_arrivals(name, vocab):
             out.append((t, Request(
                 prompt=bases[r] + tok(int(rng.integers(2, 7))),
                 max_new_tokens=4)))
+    elif name == "multitenant":
+        # the adversarial three-class tenancy mix: a NOISY NEIGHBOR
+        # burst-submitting long prompts with long decodes at t=0, a
+        # batch tenant piling on at t=0, and an interactive chat
+        # trickle arriving while both floods drain — the workload the
+        # weighted-fair-share + priority front-end exists to protect
+        for _ in range(4):
+            out.append((0, Request(prompt=tok(int(rng.integers(24, 33))),
+                                   max_new_tokens=12,
+                                   tenant_id="noisy")))
+        for _ in range(4):
+            out.append((0, Request(prompt=tok(int(rng.integers(8, 17))),
+                                   max_new_tokens=6,
+                                   tenant_id="batch")))
+        for _ in range(6):
+            t += int(rng.poisson(10.0))
+            out.append((t, Request(prompt=tok(int(rng.integers(3, 7))),
+                                   max_new_tokens=4,
+                                   tenant_id="interactive")))
+        out.sort(key=lambda e: e[0])  # stable: FIFO within a tick
     else:
         raise ValueError(f"unknown scenario {name!r}")
     return out
@@ -912,6 +932,178 @@ def bench_gpt_serving_pool(on_tpu):
         except Exception as e:  # one shape must never sink the others
             print(json.dumps({"metric": metric,
                               "error": repr(e)[:200]}), flush=True)
+
+
+def _run_multitenant(params, cfg, tenanted, only=None):
+    """One replay of the ``multitenant`` adversarial mix. Returns
+    ``(streams, gaps, stalls, tracer, sched)`` where both latency maps
+    are tenant -> per-token scheduler-tick samples measured at the
+    STREAMING SINK (the consumer's view), so the tenanted and
+    untenanted sides are scored by the identical host-side ruler —
+    the untenanted scheduler has no tenant-labeled histograms, but its
+    StreamMux still carries the request's tenant tag. ``gaps`` is the
+    decode-phase inter-token gap (first token excluded — classic ITL);
+    ``stalls`` additionally counts the FIRST token's wait since
+    arrival, because an untenanted FIFO hides ALL of its queueing pain
+    in TTFT and a pure-ITL ruler would score the starvation as a
+    win."""
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  PagedDecodeEngine, StreamMux, Tenant,
+                                  TenancyPolicy, Tracer)
+
+    trc = Tracer()
+    eng = PagedDecodeEngine(params, cfg, num_slots=2, max_len=64,
+                            num_pages=48, page_size=4, buckets=(16, 64),
+                            tracer=trc)
+    gaps, stalls, last = {}, {}, {}
+    arrival_tick = {}
+    sched = None
+
+    def sink(rid, tenant, toks):
+        tick = sched.clock
+        prev = last.get(rid)
+        if prev is not None:
+            # the batch's first token carries the inter-batch gap, the
+            # rest landed the same tick (speculative burst) — the same
+            # accounting the scheduler's ITL histograms use
+            gaps.setdefault(tenant, []).append(tick - prev)
+            gaps[tenant].extend([0] * (len(toks) - 1))
+            stalls.setdefault(tenant, []).append(tick - prev)
+            stalls[tenant].extend([0] * (len(toks) - 1))
+        else:
+            stalls.setdefault(tenant, []).append(
+                tick - arrival_tick[rid])
+            stalls[tenant].extend([0] * (len(toks) - 1))
+        last[rid] = tick
+
+    pol = None
+    if tenanted:
+        # interactive gets 4x weight AND the priority rung (may
+        # preempt a resident flood slot); batch outranks noisy on
+        # weight alone — the declared protection ladder
+        pol = TenancyPolicy((Tenant("interactive", weight=4.0,
+                                    priority=1, itl_slo_ticks=8),
+                             Tenant("noisy", weight=1.0),
+                             Tenant("batch", weight=2.0)))
+    mux = StreamMux(injector=eng.injector, tracer=trc, stats=eng.stats,
+                    sink=sink)
+    # chunked prefill on BOTH sides: the flood's 24-32-token prompts
+    # would otherwise open prefill-sized gaps in every co-resident
+    # stream, swamping the fairness signal with the head-of-line
+    # effect the chunked tier already bounds
+    sched = ContinuousBatchingScheduler(eng, eos_id=-1, chunk_tokens=8,
+                                        tenancy=pol, streams=mux)
+    arrivals = _scenario_arrivals("multitenant", cfg.vocab_size)
+    if only is not None:
+        arrivals = [(t, r) for t, r in arrivals if r.tenant_id in only]
+    # request ids are assigned in submission order == arrival order
+    arrival_tick.update({i: t for i, (t, _) in enumerate(arrivals)})
+    streams = _drive_poisson(sched, arrivals)
+    return streams, gaps, stalls, trc, sched
+
+
+def _gap_p99(gaps, tenant):
+    xs = sorted(gaps.get(tenant, ()))
+    if not xs:
+        return 0.0
+    return float(xs[min(len(xs) - 1, int(0.99 * len(xs)))])
+
+
+def bench_gpt_serving_multitenant(on_tpu):
+    """Driver config ``serving_multitenant``: the adversarial
+    three-class Poisson mix (noisy-neighbor flood x batch burst x
+    interactive trickle) through the tenanted, streaming scheduler.
+    The committed streams are asserted BIT-IDENTICAL to the untenanted
+    replay before any latency is read — tenancy moves WHEN work runs,
+    never WHAT commits — then the line scores the interactive tenant's
+    p99 ITL in scheduler ticks with per-tenant summaries, preemption/
+    SLO counters and stream-delivery stats alongside."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+    metric = "gpt_serving_multitenant_interactive_itl_p99_ticks"
+    try:
+        streams_t, gaps_t, stalls_t, trc, sched = _run_multitenant(
+            params, cfg, tenanted=True)
+        streams_u, gaps_u, stalls_u, _, _ = _run_multitenant(
+            params, cfg, tenanted=False)
+        assert streams_t == streams_u, \
+            "tenanted committed streams diverged from untenanted"
+        lat = trc.tenant_latency_summary("interactive")
+        extra = {"seed": _SCENARIO_SEED["multitenant"],
+                 "requests": len(streams_t),
+                 "tokens": sum(len(s) for s in streams_t),
+                 "interactive_itl_p99_untenanted":
+                     _gap_p99(gaps_u, "interactive"),
+                 "interactive_stall_p99":
+                     _gap_p99(stalls_t, "interactive"),
+                 "interactive_stall_p99_untenanted":
+                     _gap_p99(stalls_u, "interactive"),
+                 "noisy_stall_p99": _gap_p99(stalls_t, "noisy"),
+                 "noisy_stall_p99_untenanted":
+                     _gap_p99(stalls_u, "noisy"),
+                 "chunk_deferrals": sched.stats.chunk_deferrals,
+                 "tenant_preemptions": sched.stats.tenant_preemptions,
+                 "slo_violations": sched.stats.slo_violations,
+                 "stream_batches": sched.stats.stream_batches,
+                 "stream_tokens": sched.stats.stream_tokens}
+        extra.update(lat)
+        _maybe_dump_trace(trc, "multitenant")
+        emit(metric, _gap_p99(gaps_t, "interactive"), "ticks",
+             extra=extra, higher_is_better=False)
+    except Exception as e:
+        print(json.dumps({"metric": metric,
+                          "error": repr(e)[:200]}), flush=True)
+
+
+def _tenancy_vs_untenanted_ab_pair(on_tpu):
+    """(side_a, side_b): the tenanted scheduler (4x interactive
+    weight + priority rung + fair-share chunk throttle) vs untenanted
+    FIFO on the same seeded adversarial multitenant mix, scored as the
+    INTERACTIVE tenant's P99 PER-TOKEN DELIVERY STALL IN SCHEDULER
+    TICKS at the streaming sink — the first token's wait counts from
+    ARRIVAL, because FIFO hides all its queueing pain in TTFT and a
+    pure inter-token ruler would score the starvation as a win. The
+    committed streams are asserted bit-identical FIRST — fairness may
+    only move the clock — then the noisy-neighbor contract is pinned:
+    the interactive DECODE-PHASE tail (classic ITL, first token
+    excluded) stays within 1.5x its solo run (interactive arrivals
+    alone on an idle engine) while the noisy tenant's stall tail
+    strictly DEGRADES (the flood pays for the protection). Both sides
+    replay identical arrivals, so each sample is an exact replica and
+    the band collapses to the point ratio. Ratio < 1 = fair share +
+    priority protect the interactive tail."""
+    import dataclasses as _dc
+
+    from apex_tpu.models.gpt import gpt_tiny, init_gpt
+
+    cfg = _dc.replace(gpt_tiny(), use_rope=True, hidden_dropout=0.0)
+    params = init_gpt(jax.random.PRNGKey(0), cfg)
+
+    streams_t, gaps_t, stalls_t, _, _ = _run_multitenant(
+        params, cfg, True)
+    streams_u, gaps_u, stalls_u, _, _ = _run_multitenant(
+        params, cfg, False)
+    assert streams_t == streams_u, \
+        "tenanted committed streams diverged from untenanted"
+    _, gaps_solo, _, _, _ = _run_multitenant(params, cfg, False,
+                                             only=("interactive",))
+    inter_itl = _gap_p99(gaps_t, "interactive")
+    inter_solo = _gap_p99(gaps_solo, "interactive")
+    assert inter_itl <= 1.5 * inter_solo + 1.0, \
+        (f"interactive p99 ITL {inter_itl} ticks exceeds 1.5x solo "
+         f"({inter_solo} ticks): the noisy neighbor leaked through")
+    noisy_t = _gap_p99(stalls_t, "noisy")
+    noisy_u = _gap_p99(stalls_u, "noisy")
+    assert noisy_t >= noisy_u, \
+        (f"noisy tenant p99 stall improved under tenancy "
+         f"({noisy_u} -> {noisy_t} ticks): the flood must pay, "
+         "not profit")
+    return (lambda: float(_gap_p99(stalls_t, "interactive"))), \
+        (lambda: float(_gap_p99(stalls_u, "interactive")))
 
 
 def _spec_decode_setup(on_tpu, spec_k, tracer=None):
@@ -2105,6 +2297,9 @@ AB_PAIRS = {
     "spec_tree_vs_linear": (
         "tree_grid", "linear_chain",
         _spec_tree_vs_linear_ab_pair),
+    "serving_tenancy_vs_untenanted": (
+        "tenanted_fair_share", "untenanted_fifo",
+        _tenancy_vs_untenanted_ab_pair),
 }
 
 
@@ -2557,6 +2752,7 @@ CONFIGS = {
     "gpt_spec_natural": bench_gpt_spec_natural,
     "gpt_serving_scenarios": bench_gpt_serving_scenarios,
     "serving_pool_scaling": bench_gpt_serving_pool,
+    "serving_multitenant": bench_gpt_serving_multitenant,
 }
 
 # Driver execution order (round-4 postmortem). The HEADLINE runs FIRST:
@@ -2569,6 +2765,7 @@ CONFIGS = {
 # convention still lands on the contract metric.
 ORDER = ["headline", "gpt_decode", "gpt_spec_natural",
          "gpt_serving_scenarios", "serving_pool_scaling",
+         "serving_multitenant",
          "kernel_parity", "flash_attention",
          "ab_kernels", "layer_norm", "opt_adam", "opt_lamb",
          "opt_flat_vs_tree", "ddp_bert", "tp_gpt"]
@@ -2583,7 +2780,8 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2700"))
 CAP_S = {"headline": 600, "kernel_parity": 480, "ddp_bert": 540,
          "tp_gpt": 600, "flash_attention": 540, "ab_kernels": 540,
          "gpt_decode": 420, "gpt_spec_natural": 420,
-         "gpt_serving_scenarios": 420, "serving_pool_scaling": 420}
+         "gpt_serving_scenarios": 420, "serving_pool_scaling": 420,
+         "serving_multitenant": 420}
 DEFAULT_CAP_S = 480
 
 

@@ -54,12 +54,15 @@ case "$tier" in
   all)   exec python -m pytest tests -q "$@" ;;
   quick) # the -m quick subset, then a few-arrival smoke of the
          # seeded-Poisson serving bench (tiny model, chat mix only via
-         # APEX_BENCH_SCENARIOS) so scheduler-policy regressions
-         # surface in the inner loop, not first in CI
+         # APEX_BENCH_SCENARIOS) plus the multi-tenant adversarial
+         # mix, so scheduler-policy regressions surface in the inner
+         # loop, not first in CI
          python -m pytest tests -q -m quick "$@"
          echo "quick: Poisson serving-bench smoke (chat mix)" >&2
-         exec env APEX_BENCH_SCENARIOS=chat python bench.py \
-             gpt_serving_scenarios ;;
+         env APEX_BENCH_SCENARIOS=chat python bench.py \
+             gpt_serving_scenarios
+         echo "quick: multi-tenant serving smoke (adversarial mix)" >&2
+         exec python bench.py serving_multitenant ;;
   chaos) # per-seed trace dumps land next to this path (a tag + seed
          # suffix is spliced in before the extension); set it empty to
          # disable the dump

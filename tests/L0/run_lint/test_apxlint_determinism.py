@@ -160,8 +160,8 @@ def test_seeded_unordered_routing_key_caught(tmp_path):
     becomes hash-dependent — and APX801 must fire."""
     dst = _scratch_serving(tmp_path)
     _mutate(dst / "router.py",
-            "return min(cands, key=self._load_key)",
-            "return list(set(cands))[0]")
+            "return self._note_route(min(cands, key=self._load_key))",
+            "return self._note_route(list(set(cands))[0])")
     findings = _apx8([dst], "APX801")
     assert len(findings) == 1, [f.render() for f in findings]
     assert findings[0].path.endswith("router.py")

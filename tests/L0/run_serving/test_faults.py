@@ -622,8 +622,9 @@ def test_error_taxonomy_contract():
     APX803 verify, exercised live so a rename breaks a test before it
     breaks the lint."""
     from apex_tpu.serving import (
-        InjectedFault, NonFiniteLogits, PromoteFailed,
-        ReplicaUnavailable, ServingError, SpillFailed, health,
+        InjectedFault, NonFiniteLogits, PromoteFailed, QuotaExhausted,
+        ReplicaUnavailable, ServingError, SloViolation, SpillFailed,
+        StreamFailed, health,
     )
     from apex_tpu.serving.faults import SITE_CONTRACTS
 
@@ -650,6 +651,15 @@ def test_error_taxonomy_contract():
     assert sf.key == "ab12" and sf.payload["key"] == "ab12"
     pf = PromoteFailed("stale header", key="cd34", pages=2)
     assert pf.pages == 2 and pf.payload == {"key": "cd34", "pages": 2}
+    sfl = StreamFailed("emit dropped", request_id=3, delivered=5,
+                       dropped=2)
+    assert sfl.payload == {"request_id": 3, "delivered": 5, "dropped": 2}
+    qx = QuotaExhausted("over quota", tenant="small", need=6, quota=4,
+                        charged=0)
+    assert qx.tenant == "small" and qx.payload["need"] == 6
+    sv = SloViolation("ttft blown", tenant="chat", metric="ttft",
+                      observed=9, bound=4)
+    assert sv.metric == "ttft" and sv.payload["bound"] == 4
 
     # InjectedFault is the injector's typed carrier, not a ServingError:
     # the scheduler's retry ladder catches it by ITS type

@@ -1,0 +1,393 @@
+"""Chaos tier for the multi-tenant streaming front-end
+(``serving.tenancy`` + ``serving.streaming``): per-token streams,
+weighted fair share over the tick token budget, per-tenant page
+quotas, priority preemption and per-tenant SLO observability.
+
+The load-bearing contracts:
+
+- BIT-IDENTITY — tenancy reorders WHEN work happens, never WHAT
+  commits: committed streams (and therefore delivered stream tokens)
+  are integer-identical to the untenanted scheduler across plain,
+  speculative, chunked-prefill and disaggregated-pool serving;
+- a ``stream_emit`` fault degrades DELIVERY only: the batch drops,
+  the stream closes with a typed ``StreamFailed``, and its delivered
+  tokens stay a strict prefix of the committed stream — the request
+  itself keeps decoding and finishes ok;
+- quotas are typed and leak-free: a request that could never fit its
+  tenant's quota raises ``QuotaExhausted`` at ``submit()``; transient
+  pressure defers admission (``quota_deferrals``) and the reservation
+  books drain to zero once the scheduler does;
+- weighted shares converge to the declared ratios on the tick clock
+  while every tenant stays backlogged (stride scheduling);
+- ``SloViolation`` is a latency fact, not a failure: stamped into
+  ``RequestOutcome.slo`` with ``ok`` untouched;
+- the randomized multi-fault chaos sweep replays bit-for-bit
+  (outcomes, stats, injector counts, tick-clock event stream, stream
+  snapshots) and dumps tenant-labeled Perfetto artifacts.
+
+``APEX_CHAOS_TENANT_SEED`` (comma-separated ints) overrides the
+sweep's seed set — the CI chaos matrix fans one seed per leg and
+uploads each leg's Perfetto dump.
+"""
+
+import dataclasses
+import os
+
+import jax
+import pytest
+
+from apex_tpu.models.gpt import gpt_tiny, init_gpt
+from apex_tpu.serving import (
+    ContinuousBatchingScheduler, FaultInjector, PagedDecodeEngine,
+    PoolRouter, QuotaExhausted, Request, SloViolation, StreamFailed,
+    Tenant, TenancyPolicy, Tracer, FINISH_REASONS,
+)
+
+pytestmark = pytest.mark.chaos
+
+EOS = -1       # unreachable: healthy streams run to max_new_tokens
+MAX_LEN = 32
+
+#: The randomized sweep's seeds; the CI chaos matrix overrides this to
+#: one seed per leg.
+_TENANT_SEEDS = tuple(
+    int(s) for s in os.environ.get("APEX_CHAOS_TENANT_SEED",
+                                   "0,1,2").split(","))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(gpt_tiny(), use_rope=True,
+                              hidden_dropout=0.0)
+    return cfg, init_gpt(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(model, injector=None, tracer=None, num_pages=24, **kw):
+    cfg, params = model
+    kw.setdefault("tracer", tracer if tracer is not None else Tracer())
+    return PagedDecodeEngine(params, cfg, num_slots=2, max_len=MAX_LEN,
+                             num_pages=num_pages, page_size=4,
+                             buckets=(16, 32), injector=injector, **kw)
+
+
+#: The standard two-class mix: a weighted, higher-rung interactive
+#: tenant sharing the engine with a batch tenant (no quotas — the
+#: quota tests build their own policies).
+_TENANTS = (Tenant("interactive", weight=3.0, priority=1),
+            Tenant("batch", weight=1.0))
+
+_REQS = [Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=8,
+                 tenant_id="interactive"),
+         Request(prompt=(6, 7, 8), max_new_tokens=6, temperature=0.8,
+                 seed=7, tenant_id="batch"),
+         Request(prompt=(9, 10, 11, 12), max_new_tokens=4,
+                 temperature=1.1, seed=5, tenant_id="interactive")]
+
+
+def _drive(sched, reqs=_REQS):
+    for r in reqs:
+        sched.submit(r)
+    return sched.run()
+
+
+def _golden(model, reqs=_REQS, spec_k=0, chunk=None):
+    """Untenanted, unstreamed committed streams — the identity
+    baseline every tenanted run must reproduce integer-exactly."""
+    eng = _engine(model, spec_k=spec_k)
+    return _drive(ContinuousBatchingScheduler(eng, eos_id=EOS,
+                                              audit=True,
+                                              chunk_tokens=chunk), reqs)
+
+
+def _tenanted(model, injector=None, tenants=_TENANTS, spec_k=0,
+              chunk=None, num_pages=24, **skw):
+    eng = _engine(model, injector, num_pages=num_pages, spec_k=spec_k)
+    return ContinuousBatchingScheduler(
+        eng, eos_id=EOS, audit=True, chunk_tokens=chunk,
+        tenancy=TenancyPolicy(tenants), streams=True, **skw)
+
+
+# -- bit-identity: tenanted streams == untenanted committed streams ---------
+
+@pytest.mark.parametrize("spec_k,chunk", [(0, None), (2, None), (0, 8)])
+def test_tenanted_streams_bit_identical_to_untenanted(model, spec_k,
+                                                      chunk):
+    """The headline contract: weighted fair share + priority rungs +
+    per-token streaming change WHEN work runs, never WHAT commits —
+    plain, speculative and chunked-prefill committed streams are
+    integer-identical to the untenanted scheduler, and every
+    TokenStream delivered the full committed stream."""
+    golden = _golden(model, spec_k=spec_k, chunk=chunk)
+    sched = _tenanted(model, spec_k=spec_k, chunk=chunk)
+    assert _drive(sched) == golden
+    for rid, out in sorted(sched.outcomes.items()):
+        assert out.ok and out.reason in FINISH_REASONS
+        assert out.tenant_id == _REQS[rid].tenant_id
+        st = sched.streams.streams[rid]
+        assert st.closed and not st.failed
+        assert st.delivered == golden[rid]
+    assert sched.tenancy.charged_total() == 0
+    assert sched.stats.stream_tokens == sum(len(g) for g in golden)
+
+
+def test_pool_tenanted_streams_bit_identical(model):
+    """Same identity through the disaggregated pool tier: tenancy and
+    streaming ride the PoolRouter's composite engine (shared tracer +
+    injector across replicas) without perturbing a token."""
+    golden = _golden(model)
+    inj, trc = FaultInjector(), Tracer()
+    prefills = [_engine(model, inj, trc) for _ in range(2)]
+    decodes = [_engine(model, inj, trc)]
+    pool = PoolRouter(prefills, decodes, EOS, audit=True,
+                      tenancy=TenancyPolicy(_TENANTS), streams=True)
+    assert _drive(pool) == golden
+    for rid, out in sorted(pool.outcomes.items()):
+        assert out.ok and out.tenant_id == _REQS[rid].tenant_id
+        assert pool.streams.streams[rid].delivered == golden[rid]
+    assert pool.tenancy.charged_total() == 0
+
+
+# -- stream_emit chaos: strict-prefix delivery ------------------------------
+
+@pytest.mark.parametrize("spec_k,chunk", [(0, None), (2, None), (0, 8)])
+def test_stream_emit_chaos_delivers_strict_prefix(model, spec_k, chunk):
+    """Arm the ``stream_emit`` site hard: dropped delivery batches
+    close their stream with a typed ``StreamFailed`` whose delivered
+    tokens are a STRICT prefix of the committed stream — and the
+    committed streams themselves stay exactly golden (delivery is
+    host-side fan-out, never part of the commit path). Replays
+    bit-for-bit."""
+    golden = _golden(model, spec_k=spec_k, chunk=chunk)
+
+    def chaos_run():
+        sched = _tenanted(
+            model, FaultInjector(seed=3, rates={"stream_emit": 0.4}),
+            spec_k=spec_k, chunk=chunk)
+        _drive(sched)
+        return sched
+
+    sched = chaos_run()
+    assert sched.stats.stream_failures > 0
+    failed = 0
+    for rid, out in sorted(sched.outcomes.items()):
+        assert out.ok, "a delivery fault must never fail the request"
+        assert list(out.tokens) == golden[rid]
+        st = sched.streams.streams[rid]
+        assert st.closed
+        assert st.delivered == golden[rid][:len(st.delivered)]
+        if st.failed:
+            failed += 1
+            assert isinstance(st.error, StreamFailed)
+            assert st.error.payload["request_id"] == rid
+            assert len(st.delivered) < len(golden[rid]), \
+                "failed stream must be a STRICT prefix"
+    assert failed == sched.stats.stream_failures
+    replay = chaos_run()
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts
+    assert replay.streams.snapshot() == sched.streams.snapshot()
+
+
+def test_stream_emit_chaos_on_pool(model):
+    """The same strict-prefix contract through the disaggregated pool:
+    the StreamMux draws ``stream_emit`` on the pool's shared injector,
+    so dropped deliveries replay bit-for-bit there too."""
+    golden = _golden(model)
+
+    def chaos_run():
+        inj = FaultInjector(seed=5, rates={"stream_emit": 0.5})
+        trc = Tracer()
+        pool = PoolRouter([_engine(model, inj, trc) for _ in range(2)],
+                          [_engine(model, inj, trc)], EOS, audit=True,
+                          tenancy=TenancyPolicy(_TENANTS), streams=True)
+        _drive(pool)
+        return pool
+
+    pool = chaos_run()
+    assert pool.stats.stream_failures > 0
+    for rid, out in sorted(pool.outcomes.items()):
+        assert out.ok and list(out.tokens) == golden[rid]
+        st = pool.streams.streams[rid]
+        assert st.delivered == golden[rid][:len(st.delivered)]
+    replay = chaos_run()
+    assert replay.stats.as_dict() == pool.stats.as_dict()
+    assert replay.streams.snapshot() == pool.streams.snapshot()
+
+
+# -- quotas: typed at submit, deferred under pressure, leak-free ------------
+
+def test_quota_exhausted_typed_and_leak_free(model):
+    """A request that could NEVER fit its tenant's page quota raises
+    typed ``QuotaExhausted`` at ``submit()`` with the full payload;
+    requests that fit are admitted one at a time under transient
+    pressure (``quota_deferrals`` while a slot sits free) — and the
+    reservation books drain to exactly zero with the scheduler."""
+    sched = _tenanted(model, tenants=(Tenant("small", page_quota=4),))
+    pol = sched.tenancy
+    with pytest.raises(QuotaExhausted) as exc:
+        sched.submit(Request(prompt=tuple(range(1, 10)),
+                             max_new_tokens=12, tenant_id="small"))
+    assert exc.value.payload == {"tenant": "small", "need": 6,
+                                 "quota": 4, "charged": 0}
+    assert sched.stats.quota_exhausted == 1
+    assert sched.outcomes == {}, "fail-fast must not allocate an id"
+
+    # two fitting requests: worst cases 4 + 3 pages against quota 4 —
+    # the second must WAIT for the first's credit though a slot is free
+    sched.submit(Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=8,
+                         tenant_id="small"))
+    sched.submit(Request(prompt=(6, 7, 8), max_new_tokens=8,
+                         tenant_id="small"))
+    streams = sched.run()
+    assert len(streams) == 2
+    assert all(out.ok for out in sched.outcomes.values())
+    assert sched.stats.quota_deferrals >= 1
+    assert pol.charged_total() == 0
+    assert pol.ledger.charged("small") == 0
+    for rid, st in sorted(sched.streams.streams.items()):
+        assert st.delivered == streams[rid]
+
+    # unknown tenants are a config error, not a quota event
+    with pytest.raises(ValueError):
+        sched.submit(Request(prompt=(1, 2), max_new_tokens=2,
+                             tenant_id="nobody"))
+
+
+# -- weighted fair share: stride convergence on the tick clock --------------
+
+def test_weighted_shares_converge_on_tick_clock(model):
+    """Two saturating tenants at declared weights 3:1: while both stay
+    backlogged, committed-token shares converge to the weight ratio
+    (stride scheduling — each token advances its tenant's virtual time
+    by 1/weight, admission picks the lowest vtime), and backlogged
+    vtimes stay within one request's stride of each other."""
+    sched = _tenanted(model, num_pages=48,
+                      tenants=(Tenant("heavy", weight=3.0),
+                               Tenant("light", weight=1.0)))
+    pol = sched.tenancy
+    for i in range(16):
+        sched.submit(Request(prompt=(1 + i, 2, 3), max_new_tokens=6,
+                             tenant_id="heavy"))
+        sched.submit(Request(prompt=(4, 5 + i, 6), max_new_tokens=6,
+                             tenant_id="light"))
+    for _ in range(36):     # both tenants stay backlogged throughout
+        sched.step()
+    heavy, light = pol.tokens("heavy"), pol.tokens("light")
+    assert light > 0, "a 3:1 share must not starve the light tenant"
+    ratio = heavy / light
+    assert 2.2 <= ratio <= 4.0, \
+        f"share ratio {ratio:.2f} off the declared 3:1"
+    # the stride invariant: backlogged vtimes track within one
+    # request's stride (max_new_tokens / min weight)
+    assert abs(pol.vtime("heavy") - pol.vtime("light")) <= 6.5
+    sched.run()             # drain: everything still completes ok
+    assert len(sched.outcomes) == 32
+    assert all(out.ok for out in sched.outcomes.values())
+    assert pol.charged_total() == 0
+
+
+# -- priority preemption ----------------------------------------------------
+
+def test_priority_preemption_requeues_resident_lower_rung(model):
+    """A strictly-higher-rung waiting tenant preempts a resident
+    lower-rung slot (requeue via the pool-pressure resume path): the
+    ``tenant_preemptions`` counter ticks, the paid request jumps the
+    line, and every committed stream — including the preempted one's —
+    stays integer-identical to the untenanted golden."""
+    reqs = [Request(prompt=(1, 2, 3, 4, 5), max_new_tokens=12,
+                    tenant_id="free"),
+            Request(prompt=(6, 7, 8), max_new_tokens=12,
+                    tenant_id="free"),
+            Request(prompt=(9, 10, 11, 12), max_new_tokens=6,
+                    tenant_id="paid")]
+    golden = _golden(model, reqs)
+    sched = _tenanted(model, tenants=(Tenant("free", priority=0),
+                                      Tenant("paid", priority=2)))
+    sched.submit(reqs[0])
+    sched.submit(reqs[1])
+    for _ in range(3):      # both slots resident on the free tenant
+        sched.step()
+    sched.submit(reqs[2])
+    sched.run()
+    assert sched.stats.tenant_preemptions >= 1
+    for rid, out in sorted(sched.outcomes.items()):
+        assert out.ok and list(out.tokens) == golden[rid]
+        assert sched.streams.streams[rid].delivered == golden[rid]
+    paid, = [o for o in sched.outcomes.values() if o.tenant_id == "paid"]
+    free_ttfts = [o.ttft_ticks for o in sched.outcomes.values()
+                  if o.tenant_id == "free"]
+    assert paid.ttft_ticks <= min(free_ttfts) + 12, \
+        "preemption must move the paid tenant ahead of a full drain"
+
+
+# -- per-tenant SLOs --------------------------------------------------------
+
+def test_slo_violations_typed_and_observable(model):
+    """Tight TTFT/ITL bounds on an oversubscribed tenant: finished
+    requests carry a typed ``SloViolation`` in ``RequestOutcome.slo``
+    with ``ok`` untouched (an SLO miss is a latency fact, not a
+    failure), the ``slo_violations`` counter matches, and the tracer's
+    tenant-labeled latency summary is populated."""
+    sched = _tenanted(model, tenants=(
+        Tenant("strict", ttft_slo_ticks=1, itl_slo_ticks=1),))
+    reqs = [Request(prompt=(1 + i, 2, 3, 4), max_new_tokens=6,
+                    tenant_id="strict") for i in range(4)]
+    _drive(sched, reqs)
+    viols = [o for o in sched.outcomes.values() if o.slo is not None]
+    assert viols, "oversubscribed 1-tick bounds must be broken"
+    assert all(isinstance(o.slo, SloViolation) for o in viols)
+    assert all(o.slo.metric in ("ttft", "itl") for o in viols)
+    assert all(o.slo.observed > o.slo.bound for o in viols)
+    assert all(o.ok for o in sched.outcomes.values())
+    assert sched.stats.slo_violations == len(viols)
+    summary = sched.tracer.tenant_latency_summary("strict")
+    assert summary["ttft_p50"] >= 1 and summary["itl_p99"] >= 1
+
+
+# -- randomized multi-fault sweep -------------------------------------------
+
+@pytest.mark.parametrize("seed", _TENANT_SEEDS)
+def test_multi_fault_tenant_chaos_replays_bit_for_bit(model, seed):
+    """Every serving-path site armed at once (stream drops, pool
+    pressure, prefill/decode/sample cross-talk) over the tenanted,
+    streaming scheduler, audited every tick: every outcome typed,
+    every ok stream exactly golden, every degraded stream a golden
+    prefix, every delivery a strict prefix of its commit — and the
+    whole run replays bit-for-bit: outcomes, stats, injector counts,
+    stream snapshots and the tick-clock event stream."""
+    golden = _golden(model)
+    rates = {"stream_emit": 0.25, "pool_alloc": 0.1,
+             "prefill_exec": 0.1, "decode_exec": 0.1, "sample": 0.1}
+
+    def chaos_run():
+        sched = _tenanted(model, FaultInjector(seed=seed, rates=rates),
+                          num_pages=16)
+        _drive(sched)
+        return sched
+
+    sched = chaos_run()
+    assert sorted(sched.outcomes) == list(range(len(_REQS)))
+    for rid, out in sorted(sched.outcomes.items()):
+        assert out.reason in FINISH_REASONS
+        want = golden[rid]
+        if out.ok:
+            assert list(out.tokens) == want, f"request {rid} diverged"
+        else:
+            assert list(out.tokens) == want[:len(out.tokens)], \
+                f"request {rid}: degraded stream not a golden prefix"
+        st = sched.streams.streams[rid]
+        assert st.delivered == list(out.tokens)[:len(st.delivered)]
+    assert sched.tenancy.charged_total() == 0
+    replay = chaos_run()
+    assert replay.outcomes == sched.outcomes
+    assert replay.stats.as_dict() == sched.stats.as_dict()
+    assert replay.engine.injector.counts == sched.engine.injector.counts
+    assert replay.tracer.tick_stream() == sched.tracer.tick_stream()
+    assert replay.streams.snapshot() == sched.streams.snapshot()
+    # CI post-mortem artifact: one tenant-labeled Perfetto dump per
+    # sweep seed, uploaded by the chaos workflow legs
+    out_path = os.environ.get("APEX_CHAOS_TRACE_OUT")
+    if out_path:
+        root, ext = os.path.splitext(out_path)
+        sched.tracer.dump_jsonl(
+            f"{root}.tenant_seed{seed}{ext or '.jsonl'}")
